@@ -1,0 +1,106 @@
+"""Global config table, env-var overridable.
+
+Design parity: the reference's ``RAY_CONFIG(type, name, default)`` macro table
+(``src/ray/common/ray_config_def.h``, 205 entries) materialized as a singleton with
+``RAY_<name>`` env overrides.  Here: a typed registry with ``RAYTPU_<NAME>`` env
+overrides plus a runtime ``system_config`` dict applied at ``init()`` and shipped to
+every worker (the reference distributes ``_system_config`` through the GCS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAYTPU_"
+
+
+class _ConfigEntry:
+    __slots__ = ("name", "type", "default", "value")
+
+    def __init__(self, name, type_, default):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.value = default
+
+
+class Config:
+    def __init__(self):
+        self._entries: Dict[str, _ConfigEntry] = {}
+
+    def define(self, name: str, type_, default):
+        self._entries[name] = _ConfigEntry(name, type_, default)
+
+    def __getattr__(self, name: str):
+        entries = object.__getattribute__(self, "_entries")
+        if name in entries:
+            return entries[name].value
+        raise AttributeError(name)
+
+    def get(self, name: str):
+        return self._entries[name].value
+
+    def initialize(self, system_config: Dict[str, Any] | None = None):
+        """Apply env vars then the explicit system_config dict (highest priority)."""
+        for e in self._entries.values():
+            e.value = e.default
+            env = os.environ.get(_ENV_PREFIX + e.name.upper())
+            if env is not None:
+                e.value = _coerce(env, e.type)
+        for k, v in (system_config or {}).items():
+            if k not in self._entries:
+                raise ValueError(f"Unknown system config key: {k}")
+            self._entries[k].value = _coerce(v, self._entries[k].type)
+
+    def dump(self) -> Dict[str, Any]:
+        return {k: e.value for k, e in self._entries.items()}
+
+    def load(self, dumped: Dict[str, Any]):
+        for k, v in dumped.items():
+            if k in self._entries:
+                self._entries[k].value = v
+
+
+def _coerce(v, type_):
+    if isinstance(v, str):
+        if type_ is bool:
+            return v.lower() in ("1", "true", "yes")
+        if type_ in (dict, list):
+            return json.loads(v)
+        return type_(v)
+    return type_(v)
+
+
+GLOBAL_CONFIG = Config()
+_d = GLOBAL_CONFIG.define
+
+# --- core ---
+_d("object_store_memory_bytes", int, 2 * 1024**3)
+_d("inline_object_max_bytes", int, 100 * 1024)  # small objects ride in RPCs
+_d("worker_register_timeout_s", float, 60.0)
+_d("task_retry_delay_ms", int, 0)
+_d("default_max_retries", int, 3)
+_d("actor_default_max_restarts", int, 0)
+_d("health_check_period_ms", int, 1000)
+_d("health_check_timeout_ms", int, 10000)
+_d("num_heartbeats_timeout", int, 30)
+_d("lineage_pinning_enabled", bool, True)
+_d("max_lineage_bytes", int, 1024**3)
+_d("prestart_workers", bool, True)
+_d("worker_pool_min_idle", int, 0)
+_d("scheduler_spread_threshold", float, 0.5)
+_d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
+_d("memory_monitor_refresh_ms", int, 250)
+_d("memory_usage_threshold", float, 0.95)
+_d("event_stats_enabled", bool, True)
+_d("task_events_enabled", bool, True)
+_d("metrics_report_interval_ms", int, 2000)
+_d("object_spilling_enabled", bool, True)
+_d("object_spilling_threshold", float, 0.8)
+_d("gcs_storage_backend", str, "memory")  # "memory" | "file"
+_d("log_to_driver", bool, True)
+# --- tpu ---
+_d("tpu_mesh_bootstrap_timeout_s", float, 120.0)
+_d("tpu_donate_buffers", bool, True)

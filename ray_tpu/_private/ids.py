@@ -1,0 +1,128 @@
+"""Unique identifiers for tasks, objects, actors, nodes, workers.
+
+Design parity: reference ``src/ray/common/id.h`` defines 128-bit+ binary IDs with
+embedded ownership/provenance bits (TaskID embeds the parent ActorID, ObjectID embeds
+the producing TaskID plus a return-index).  We keep the same *capability* — an
+ObjectID is self-describing enough to recover its owner task — with a simpler,
+TPU-framework-appropriate layout: plain 16-byte IDs, where ObjectID = 12-byte task
+prefix + 4-byte big-endian index.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_UNIQUE_LEN = 16
+_TASK_PREFIX_LEN = 12
+
+_NIL = b"\x00" * _UNIQUE_LEN
+
+
+class BaseID:
+    __slots__ = ("_binary", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != _UNIQUE_LEN:
+            raise ValueError(
+                f"{type(self).__name__} must be {_UNIQUE_LEN} bytes, got {len(binary)}"
+            )
+        self._binary = bytes(binary)
+        self._hash = hash(self._binary)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(_UNIQUE_LEN))
+
+    @classmethod
+    def nil(cls):
+        return cls(_NIL)
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def is_nil(self) -> bool:
+        return self._binary == _NIL
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __lt__(self, other):
+        return self._binary < other._binary
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._binary.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class JobID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    """Task IDs: 12 random/derived bytes + 4 zero bytes (so ObjectIDs can embed them)."""
+
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def for_task(cls) -> "TaskID":
+        return cls(os.urandom(_TASK_PREFIX_LEN) + b"\x00" * 4)
+
+    def prefix(self) -> bytes:
+        return self._binary[:_TASK_PREFIX_LEN]
+
+
+class ObjectID(BaseID):
+    """ObjectID = task prefix (12B) + 1-based return index (4B, big endian).
+
+    Index 0 is reserved for `put` objects (which get a fresh random prefix).
+    Parity: reference ObjectID::FromIndex, src/ray/common/id.h.
+    """
+
+    @classmethod
+    def for_put(cls) -> "ObjectID":
+        return cls(os.urandom(_TASK_PREFIX_LEN) + (0).to_bytes(4, "big"))
+
+    @classmethod
+    def from_task(cls, task_id: TaskID, index: int) -> "ObjectID":
+        if index < 1:
+            raise ValueError("return index is 1-based")
+        return cls(task_id.prefix() + index.to_bytes(4, "big"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._binary[:_TASK_PREFIX_LEN] + b"\x00" * 4)
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._binary[_TASK_PREFIX_LEN:], "big")
+
+
+ObjectRefID = ObjectID

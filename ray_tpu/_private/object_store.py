@@ -1,0 +1,193 @@
+"""Python client for the native shared-memory object store.
+
+Parity: reference ``plasma::PlasmaClient`` (src/ray/object_manager/plasma/client.h)
+— create/seal/get/release/delete with zero-copy reads.  Reads return memoryviews
+over the mmap'd region; ``serialization.unpack`` reconstructs numpy arrays as
+views, so a `get` of a large array does no copy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from .ids import ObjectID
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "src", "store", "store.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "ray_tpu", "_native")
+_LIB = os.path.join(_LIB_DIR, "_raytpu_store.so")
+
+_build_lock = threading.Lock()
+
+
+def _ensure_built() -> str:
+    with _build_lock:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return _LIB
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        tmp = _LIB + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC, "-lpthread"],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_ensure_built())
+            lib.rt_store_init.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+            lib.rt_store_init.restype = ctypes.c_int
+            lib.rt_store_attach.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_store_attach.restype = ctypes.c_void_p
+            lib.rt_store_detach.argtypes = [ctypes.c_void_p]
+            lib.rt_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.rt_store_create.restype = ctypes.c_int64
+            lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_store_seal.restype = ctypes.c_int
+            lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_store_abort.restype = ctypes.c_int
+            lib.rt_store_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_double,
+            ]
+            lib.rt_store_get.restype = ctypes.c_int64
+            lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_store_release.restype = ctypes.c_int
+            lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_store_delete.restype = ctypes.c_int
+            lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_store_contains.restype = ctypes.c_int
+            lib.rt_store_stats.argtypes = [ctypes.c_void_p] + [
+                ctypes.POINTER(ctypes.c_uint64)
+            ] * 4
+            _lib = lib
+        return _lib
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class SharedMemoryStore:
+    """One per node; attachable from many processes."""
+
+    def __init__(self, path: str, base: int, size: int, mm: mmap.mmap):
+        self.path = path
+        self._base = base
+        self._size = size
+        self._mm = mm
+        self._view = memoryview(mm)
+        self._lib = _load()
+
+    # -- lifecycle --
+    @classmethod
+    def create(cls, path: str, size: int, table_capacity: int = 0) -> "SharedMemoryStore":
+        lib = _load()
+        if table_capacity <= 0:
+            # scale with store size: one slot per 16KB, clamped
+            table_capacity = max(1024, min(1 << 20, size // (16 * 1024)))
+        rc = lib.rt_store_init(path.encode(), size, table_capacity)
+        if rc != 0:
+            raise OSError(-rc, f"store init failed: {os.strerror(-rc)}")
+        return cls.attach(path)
+
+    @classmethod
+    def attach(cls, path: str) -> "SharedMemoryStore":
+        lib = _load()
+        size = ctypes.c_uint64()
+        base = lib.rt_store_attach(path.encode(), ctypes.byref(size))
+        if not base:
+            raise OSError(f"cannot attach store at {path}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, size.value)
+        finally:
+            os.close(fd)
+        return cls(path, base, size.value, mm)
+
+    def close(self):
+        if self._base:
+            try:
+                self._view.release()
+            except Exception:
+                pass
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # outstanding zero-copy views; mapping stays until GC
+            self._lib.rt_store_detach(self._base)
+            self._base = 0
+
+    # -- object ops --
+    def create_buffer(self, oid: ObjectID, size: int) -> memoryview:
+        off = self._lib.rt_store_create(self._base, oid.binary(), size)
+        if off == -1:
+            raise StoreFullError(f"object store full allocating {size} bytes")
+        if off == -2:
+            raise ObjectExistsError(oid.hex())
+        if off < 0:
+            raise RuntimeError(f"store create failed rc={off}")
+        return self._view[off : off + size]
+
+    def seal(self, oid: ObjectID):
+        rc = self._lib.rt_store_seal(self._base, oid.binary())
+        if rc != 0:
+            raise RuntimeError(f"seal failed for {oid.hex()}")
+
+    def abort(self, oid: ObjectID):
+        self._lib.rt_store_abort(self._base, oid.binary())
+
+    def put(self, oid: ObjectID, data) -> None:
+        mv = memoryview(data)
+        buf = self.create_buffer(oid, mv.nbytes)
+        buf[:] = mv
+        self.seal(oid)
+        self.release(oid)
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
+        """Returns a zero-copy view (caller must release(oid) when done), or
+        None if not present within timeout."""
+        size = ctypes.c_uint64()
+        off = self._lib.rt_store_get(
+            self._base, oid.binary(), ctypes.byref(size), float(timeout or 0)
+        )
+        if off < 0:
+            return None
+        return self._view[off : off + size.value]
+
+    def release(self, oid: ObjectID):
+        self._lib.rt_store_release(self._base, oid.binary())
+
+    def delete(self, oid: ObjectID):
+        self._lib.rt_store_delete(self._base, oid.binary())
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.rt_store_contains(self._base, oid.binary()))
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.rt_store_stats(self._base, *[ctypes.byref(v) for v in vals])
+        return {
+            "bytes_allocated": vals[0].value,
+            "arena_size": vals[1].value,
+            "num_objects": vals[2].value,
+            "num_evictions": vals[3].value,
+        }

@@ -1,0 +1,296 @@
+"""Wire layer: length-prefixed msgpack RPC over unix sockets.
+
+Design parity: reference L1 (``src/ray/rpc/`` gRPC wrappers + per-process asio
+``instrumented_io_context``).  Every process runs ONE IO event loop on a dedicated
+thread; all servers/clients in the process share it.  Calls from compute threads
+hop onto the loop via ``run_coroutine_threadsafe``.  Per-method latency/count stats
+are recorded (parity: grpc_server.h per-method stats, event_stats.h).
+
+Frame format: [u32 len][msgpack payload].
+Message: [kind, seqno, method, data]  kind: 0=request 1=reply 2=error 3=notify.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import threading
+import time
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+_REQUEST, _REPLY, _ERROR, _NOTIFY = 0, 1, 2, 3
+
+_MAX_FRAME = 1 << 31
+
+
+class EventLoopThread:
+    """One per process: the IO loop everything in-process shares."""
+
+    _instance: Optional["EventLoopThread"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self._run, name="raytpu-io", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance.thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            inst = cls._instance
+            cls._instance = None
+        if inst is not None and inst.thread.is_alive():
+            inst.loop.call_soon_threadsafe(inst.loop.stop)
+            inst.thread.join(timeout=5)
+
+    def run(self, coro) -> Any:
+        """Run coroutine on the IO loop from any other thread, return result."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result()
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def call_soon(self, fn, *args):
+        self.loop.call_soon_threadsafe(fn, *args)
+
+
+class MethodStats:
+    """Per-method call counts + cumulative latency (reference: event_stats.h)."""
+
+    def __init__(self):
+        self.counts = collections.Counter()
+        self.total_ms = collections.defaultdict(float)
+
+    def record(self, method: str, ms: float):
+        self.counts[method] += 1
+        self.total_ms[method] += ms
+
+    def snapshot(self):
+        return {
+            m: {"count": c, "total_ms": self.total_ms[m]}
+            for m, c in self.counts.items()
+        }
+
+
+class Connection:
+    """A framed duplex connection. Owned by the IO loop."""
+
+    def __init__(self, reader, writer, handler=None, name=""):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler  # async fn(conn, method, data) -> reply
+        self.name = name
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+
+    def start(self):
+        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                n = int.from_bytes(hdr, "big")
+                if n > _MAX_FRAME:
+                    raise ConnectionError("frame too large")
+                body = await self.reader.readexactly(n)
+                msg = msgpack.unpackb(body, raw=False)
+                kind, seqno, method, data = msg
+                if kind == _REQUEST:
+                    asyncio.get_running_loop().create_task(
+                        self._handle(seqno, method, data)
+                    )
+                elif kind == _NOTIFY:
+                    asyncio.get_running_loop().create_task(
+                        self._handle(None, method, data)
+                    )
+                elif kind in (_REPLY, _ERROR):
+                    fut = self._pending.pop(seqno, None)
+                    if fut is not None and not fut.done():
+                        if kind == _REPLY:
+                            fut.set_result(data)
+                        else:
+                            fut.set_exception(RpcError(data))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._do_close()
+
+    async def _handle(self, seqno, method, data):
+        try:
+            t0 = time.monotonic()
+            reply = await self.handler(self, method, data)
+            _global_stats.record(method, (time.monotonic() - t0) * 1e3)
+            if seqno is not None:
+                await self._send(_REPLY, seqno, method, reply)
+        except Exception:
+            if seqno is not None:
+                try:
+                    await self._send(_ERROR, seqno, method, traceback.format_exc())
+                except Exception:
+                    pass
+
+    async def _send(self, kind, seqno, method, data):
+        body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
+        async with self._write_lock:
+            self.writer.write(len(body).to_bytes(4, "big"))
+            self.writer.write(body)
+            await self.writer.drain()
+
+    async def call_async(self, method: str, data: Any, timeout=None) -> Any:
+        seqno = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seqno] = fut
+        await self._send(_REQUEST, seqno, method, data)
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify_async(self, method: str, data: Any):
+        await self._send(_NOTIFY, None, method, data)
+
+    def _do_close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(f"connection {self.name} closed"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            cb, self.on_close = self.on_close, None
+            cb(self)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def close(self):
+        self._do_close()
+
+
+class RpcError(Exception):
+    pass
+
+
+_global_stats = MethodStats()
+
+
+def method_stats() -> MethodStats:
+    return _global_stats
+
+
+class Server:
+    """Unix-socket RPC server living on the process IO loop."""
+
+    def __init__(self, path: str, handler, name=""):
+        self.path = path
+        self.handler = handler
+        self.name = name
+        self.connections: list[Connection] = []
+        self._server = None
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handler, name=self.name)
+        self.connections.append(conn)
+        conn.on_close = lambda c: self.connections.remove(c) if c in self.connections else None
+        conn.start()
+
+    async def start_async(self):
+        self._server = await asyncio.start_unix_server(self._on_client, path=self.path)
+
+    async def stop_async(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for c in list(self.connections):
+            c._do_close()
+
+
+class Client:
+    """Sync facade over a Connection for non-IO threads."""
+
+    def __init__(self, conn: Connection, io: EventLoopThread):
+        self.conn = conn
+        self.io = io
+
+    @classmethod
+    def connect(cls, path: str, handler=None, timeout=30.0, name="") -> "Client":
+        io = EventLoopThread.get()
+
+        async def _connect():
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    reader, writer = await asyncio.open_unix_connection(path)
+                    break
+                except (ConnectionRefusedError, FileNotFoundError):
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.05)
+            conn = Connection(reader, writer, handler or _null_handler, name=name)
+            conn.start()
+            return conn
+
+        return cls(io.run(_connect()), io)
+
+    def call(self, method: str, data: Any = None, timeout=None) -> Any:
+        return self.io.run(self.conn.call_async(method, data, timeout=timeout))
+
+    def notify(self, method: str, data: Any = None):
+        self.io.run(self.conn.notify_async(method, data))
+
+    def close(self):
+        if not self.conn.closed:
+            self.io.call_soon(self.conn._do_close)
+
+    @property
+    def closed(self):
+        return self.conn.closed
+
+
+async def _null_handler(conn, method, data):
+    raise RpcError(f"no handler for {method}")
+
+
+def handler_table(obj, prefix=""):
+    """Build an async dispatch fn from methods named `rpc_<method>` on obj."""
+
+    async def handle(conn, method, data):
+        fn = getattr(obj, "rpc_" + method, None)
+        if fn is None:
+            raise RpcError(f"{type(obj).__name__}: unknown method {method}")
+        res = fn(conn, data)
+        if asyncio.iscoroutine(res):
+            res = await res
+        return res
+
+    return handle

@@ -1,0 +1,87 @@
+"""Serialization: cloudpickle with out-of-band zero-copy buffers.
+
+Design parity: reference ``python/ray/_private/serialization.py`` — cloudpickle
+(protocol 5) with out-of-band pickle buffers so large numpy/jax host arrays are
+written into the shared-memory store without an extra copy, and read back as
+zero-copy views.  ObjectRefs found inside values are swapped for a picklable
+descriptor and re-hydrated on the other side (so the borrower protocol can see
+them — reference: _raylet.pyx serialization hooks).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Wire format of a serialized object:
+#   [u32 meta_len][meta pickle][u64 nbuf][u64 len_i ...][buffer bytes ...]
+# meta pickle is the cloudpickle of the object with PickleBuffers externalized.
+
+_PROTOCOL = 5
+
+
+def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Returns (meta_bytes, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=_PROTOCOL, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    return meta, views
+
+
+def deserialize(meta: bytes, buffers: List[memoryview]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def pack(value: Any) -> bytes:
+    """Single-buffer wire form (for RPC-inlined objects)."""
+    meta, views = serialize(value)
+    out = io.BytesIO()
+    out.write(len(meta).to_bytes(4, "big"))
+    out.write(meta)
+    out.write(len(views).to_bytes(8, "big"))
+    for v in views:
+        out.write(v.nbytes.to_bytes(8, "big"))
+    for v in views:
+        out.write(v)
+    return out.getvalue()
+
+
+def packed_size(value: Any) -> Tuple[bytes, List[memoryview], int]:
+    """Serialize and compute total wire size without concatenating."""
+    meta, views = serialize(value)
+    total = 4 + len(meta) + 8 + 8 * len(views) + sum(v.nbytes for v in views)
+    return meta, views, total
+
+
+def pack_into(meta: bytes, views: List[memoryview], dest: memoryview) -> int:
+    """Write wire form into a pre-allocated buffer (e.g. shm store slot)."""
+    pos = 0
+    dest[pos : pos + 4] = len(meta).to_bytes(4, "big"); pos += 4
+    dest[pos : pos + len(meta)] = meta; pos += len(meta)
+    dest[pos : pos + 8] = len(views).to_bytes(8, "big"); pos += 8
+    for v in views:
+        dest[pos : pos + 8] = v.nbytes.to_bytes(8, "big"); pos += 8
+    for v in views:
+        n = v.nbytes
+        dest[pos : pos + n] = v.cast("B") if v.format != "B" or v.ndim != 1 else v
+        pos += n
+    return pos
+
+
+def unpack(data) -> Any:
+    """Zero-copy read: `data` may be bytes or a memoryview over shm."""
+    mv = memoryview(data)
+    pos = 0
+    meta_len = int.from_bytes(mv[pos : pos + 4], "big"); pos += 4
+    meta = bytes(mv[pos : pos + meta_len]); pos += meta_len
+    nbuf = int.from_bytes(mv[pos : pos + 8], "big"); pos += 8
+    lens = []
+    for _ in range(nbuf):
+        lens.append(int.from_bytes(mv[pos : pos + 8], "big")); pos += 8
+    buffers = []
+    for n in lens:
+        buffers.append(mv[pos : pos + n]); pos += n
+    return deserialize(meta, buffers)

@@ -1,0 +1,120 @@
+"""Exceptions.  Errors propagate *as objects* through the object store and are
+re-raised at the caller — parity with reference ``python/ray/exceptions.py``
+(RayTaskError:86, RayActorError:251, ObjectLostError:405, etc.)."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised by user task/actor code; re-raised at ray.get().
+
+    Parity: reference RayTaskError (python/ray/exceptions.py:86) — carries the
+    remote traceback so the caller sees where the failure happened.
+    """
+
+    def __init__(self, function_name="", traceback_str="", cause=None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(function_name, traceback_str)
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, function_name: str) -> "TaskError":
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )
+        return cls(function_name=function_name, traceback_str=tb, cause=exc)
+
+    def __str__(self):
+        return (
+            f"Task failed in {self.function_name!r}. "
+            f"Remote traceback:\n{self.traceback_str}"
+        )
+
+
+class ActorError(RayTpuError):
+    """The actor died before/while executing this method.
+
+    Parity: reference RayActorError (exceptions.py:251)."""
+
+    def __init__(self, actor_id=None, reason=""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} died: {reason}")
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost (all copies gone) and could not be reconstructed.
+
+    Parity: reference ObjectLostError (exceptions.py:405)."""
+
+    def __init__(self, object_ref_hex="", reason=""):
+        self.object_ref_hex = object_ref_hex
+        self.reason = reason
+        super().__init__(f"Object {object_ref_hex} lost: {reason}")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction gave up (retries exhausted or lineage evicted).
+
+    Parity: exceptions.py:557."""
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Parity: exceptions.py:377 — task killed by the memory monitor."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    def __init__(self, node_id=None):
+        self.node_id = node_id
+        super().__init__(f"Node {node_id} died")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+# Internal marker type stored in the object store in place of a value.
+class ErrorObject:
+    """Serialized into the store for failed tasks; unwrapped+raised at get()."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error

@@ -1,0 +1,490 @@
+// Shared-memory object store — native core of the object plane.
+//
+// Design parity: reference plasma store (src/ray/object_manager/plasma/store.h:55,
+// dlmalloc.cc arena, eviction_policy.h LRU, create_request_queue.h backpressure).
+// Differences, deliberate for the TPU build:
+//   * The store is a *library over one mmap'd file region* attached by every
+//     process on the node — no separate store daemon and no unix-socket/fd-passing
+//     protocol (plasma's fling.cc).  On a TPU host all workers are trusted peers of
+//     one raylet; a robust process-shared mutex + condvar replaces the socket
+//     round-trips, which removes the create/get IPC from the hot path entirely.
+//   * Allocation is a first-fit free list with boundary-tag coalescing (replacing
+//     vendored dlmalloc) — objects here are large tensor buffers, so allocator
+//     micro-performance matters less than zero-copy access.
+//   * Object data layout is flat bytes; the Python layer stores pickle5
+//     out-of-band buffers so numpy/jax host arrays are zero-copy views.
+//
+// Concurrency: one PTHREAD_PROCESS_SHARED + ROBUST mutex guards the table and
+// arena; a process-shared condvar broadcasts seals so rt_store_get can block.
+//
+// Build: g++ -O2 -shared -fPIC -o _raytpu_store.so store.cpp -lpthread
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254535452544F52ULL;  // "RTSTRTOR"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kHeaderSize = 4096;
+constexpr uint64_t kAlign = 64;
+
+// ---- object table entry ----
+enum EntryState : uint32_t {
+  ENTRY_FREE = 0,
+  ENTRY_CREATED = 1,   // allocated, being written
+  ENTRY_SEALED = 2,    // immutable, readable
+  ENTRY_TOMBSTONE = 3, // deleted slot (keeps probe chains intact)
+};
+
+struct Entry {
+  uint8_t id[16];
+  uint32_t state;
+  uint32_t flags;       // bit0: delete_pending
+  uint64_t offset;      // data offset from region base
+  uint64_t data_size;
+  int64_t refcount;
+  // LRU doubly-linked list (indices into table; -1 = none). Only sealed,
+  // refcount==0 objects are on the list.
+  int64_t lru_prev;
+  int64_t lru_next;
+  uint64_t seq;         // insertion sequence for stats
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t _pad0;
+  uint64_t region_size;
+  uint64_t table_offset;
+  uint64_t table_capacity;
+  uint64_t arena_offset;
+  uint64_t arena_size;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+  // allocator: head of free list (offset into arena, -1 none)
+  int64_t free_head;
+  // LRU list heads (table indices)
+  int64_t lru_head;  // least recently used
+  int64_t lru_tail;  // most recently used
+  // stats
+  uint64_t bytes_allocated;
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t seq_counter;
+};
+
+// ---- arena block ----
+// Every block: [BlockHeader][payload]. Free blocks additionally hold free-list
+// links at the start of payload. Boundary tag: block size is stored in the
+// header; prev block's size in prev_size enables coalescing with the left
+// neighbour.
+struct BlockHeader {
+  uint64_t size;       // total block size incl header
+  uint64_t prev_size;  // size of block to the left (0 if first)
+  uint32_t free_;      // 1 if free
+  uint32_t _pad;
+};
+
+struct FreeLinks {
+  int64_t next;  // arena offset of next free block, -1 end
+  int64_t prev;
+};
+
+inline Header* H(void* base) { return reinterpret_cast<Header*>(base); }
+inline Entry* table(void* base) {
+  return reinterpret_cast<Entry*>(static_cast<char*>(base) + H(base)->table_offset);
+}
+inline BlockHeader* block_at(void* base, int64_t arena_off) {
+  return reinterpret_cast<BlockHeader*>(
+      static_cast<char*>(base) + H(base)->arena_offset + arena_off);
+}
+inline FreeLinks* links(BlockHeader* b) {
+  return reinterpret_cast<FreeLinks*>(reinterpret_cast<char*>(b) + sizeof(BlockHeader));
+}
+inline int64_t arena_off(void* base, BlockHeader* b) {
+  return reinterpret_cast<char*>(b) - (static_cast<char*>(base) + H(base)->arena_offset);
+}
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over 16 bytes
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 16; i++) { h ^= id[i]; h *= 1099511628211ULL; }
+  return h;
+}
+
+// ---------- locking (robust mutex: recover if an owner died) ----------
+int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
+
+// ---------- free list ----------
+void freelist_insert(void* base, BlockHeader* b) {
+  Header* h = H(base);
+  b->free_ = 1;
+  FreeLinks* l = links(b);
+  l->next = h->free_head;
+  l->prev = -1;
+  if (h->free_head >= 0) links(block_at(base, h->free_head))->prev = arena_off(base, b);
+  h->free_head = arena_off(base, b);
+}
+
+void freelist_remove(void* base, BlockHeader* b) {
+  Header* h = H(base);
+  FreeLinks* l = links(b);
+  if (l->prev >= 0) links(block_at(base, l->prev))->next = l->next;
+  else h->free_head = l->next;
+  if (l->next >= 0) links(block_at(base, l->next))->prev = l->prev;
+  b->free_ = 0;
+}
+
+BlockHeader* right_neighbor(void* base, BlockHeader* b) {
+  Header* h = H(base);
+  int64_t off = arena_off(base, b) + (int64_t)b->size;
+  if ((uint64_t)off >= h->arena_size) return nullptr;
+  return block_at(base, off);
+}
+
+BlockHeader* left_neighbor(void* base, BlockHeader* b) {
+  if (b->prev_size == 0) return nullptr;
+  return block_at(base, arena_off(base, b) - (int64_t)b->prev_size);
+}
+
+// Allocate a block with payload >= need. Returns arena offset of payload or -1.
+int64_t arena_alloc(void* base, uint64_t need) {
+  Header* h = H(base);
+  uint64_t want = align_up(need + sizeof(BlockHeader), kAlign);
+  int64_t cur = h->free_head;
+  while (cur >= 0) {
+    BlockHeader* b = block_at(base, cur);
+    if (b->size >= want) {
+      freelist_remove(base, b);
+      uint64_t remainder = b->size - want;
+      if (remainder >= sizeof(BlockHeader) + kAlign) {
+        // split
+        b->size = want;
+        BlockHeader* rest = right_neighbor(base, b);
+        rest->size = remainder;
+        rest->prev_size = want;
+        rest->free_ = 0;
+        BlockHeader* rr = right_neighbor(base, rest);
+        if (rr) rr->prev_size = remainder;
+        freelist_insert(base, rest);
+      }
+      h->bytes_allocated += b->size;
+      return arena_off(base, b) + (int64_t)sizeof(BlockHeader);
+    }
+    cur = links(b)->next;
+  }
+  return -1;
+}
+
+void arena_free(void* base, int64_t payload_off) {
+  Header* h = H(base);
+  BlockHeader* b = block_at(base, payload_off - (int64_t)sizeof(BlockHeader));
+  h->bytes_allocated -= b->size;
+  // coalesce right
+  BlockHeader* r = right_neighbor(base, b);
+  if (r && r->free_) {
+    freelist_remove(base, r);
+    b->size += r->size;
+    BlockHeader* rr = right_neighbor(base, b);
+    if (rr) rr->prev_size = b->size;
+  }
+  // coalesce left
+  BlockHeader* l = left_neighbor(base, b);
+  if (l && l->free_) {
+    freelist_remove(base, l);
+    l->size += b->size;
+    BlockHeader* rr = right_neighbor(base, l);
+    if (rr) rr->prev_size = l->size;
+    b = l;
+  }
+  freelist_insert(base, b);
+}
+
+// ---------- table ----------
+Entry* find_entry(void* base, const uint8_t* id, bool create_slot) {
+  Header* h = H(base);
+  Entry* t = table(base);
+  uint64_t cap = h->table_capacity;
+  uint64_t i = id_hash(id) % cap;
+  Entry* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < cap; probe++, i = (i + 1) % cap) {
+    Entry* e = &t[i];
+    if (e->state == ENTRY_FREE) {
+      if (create_slot) return first_tomb ? first_tomb : e;
+      return nullptr;
+    }
+    if (e->state == ENTRY_TOMBSTONE) {
+      if (!first_tomb) first_tomb = e;
+      continue;
+    }
+    if (memcmp(e->id, id, 16) == 0) return e;
+  }
+  return create_slot ? first_tomb : nullptr;
+}
+
+// ---------- LRU ----------
+int64_t entry_index(void* base, Entry* e) { return e - table(base); }
+
+void lru_push_tail(void* base, Entry* e) {
+  Header* h = H(base);
+  int64_t idx = entry_index(base, e);
+  e->lru_prev = h->lru_tail;
+  e->lru_next = -1;
+  if (h->lru_tail >= 0) table(base)[h->lru_tail].lru_next = idx;
+  h->lru_tail = idx;
+  if (h->lru_head < 0) h->lru_head = idx;
+}
+
+void lru_remove(void* base, Entry* e) {
+  Header* h = H(base);
+  if (e->lru_prev >= 0) table(base)[e->lru_prev].lru_next = e->lru_next;
+  else if (h->lru_head == entry_index(base, e)) h->lru_head = e->lru_next;
+  if (e->lru_next >= 0) table(base)[e->lru_next].lru_prev = e->lru_prev;
+  else if (h->lru_tail == entry_index(base, e)) h->lru_tail = e->lru_prev;
+  e->lru_prev = e->lru_next = -1;
+}
+
+void delete_entry_locked(void* base, Entry* e) {
+  Header* h = H(base);
+  if (e->state == ENTRY_SEALED && e->refcount == 0) lru_remove(base, e);
+  arena_free(base, (int64_t)(e->offset - h->arena_offset));
+  e->state = ENTRY_TOMBSTONE;
+  h->num_objects--;
+}
+
+// Evict LRU sealed refcount-0 objects until `needed` bytes could be free.
+// Returns true if anything was evicted.
+bool evict_for(void* base, uint64_t needed) {
+  Header* h = H(base);
+  bool any = false;
+  while (h->lru_head >= 0 &&
+         h->arena_size - h->bytes_allocated < needed + sizeof(BlockHeader) + kAlign) {
+    Entry* victim = &table(base)[h->lru_head];
+    delete_entry_locked(base, victim);
+    h->num_evictions++;
+    any = true;
+  }
+  return any;
+}
+
+timespec deadline_after(double seconds) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += (time_t)seconds;
+  ts.tv_nsec += (long)((seconds - (time_t)seconds) * 1e9);
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+  return ts;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize a store file of `size` bytes. Returns 0, or -errno.
+int rt_store_init(const char* path, uint64_t size, uint64_t table_capacity) {
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd < 0) return -errno;
+  if (ftruncate(fd, (off_t)size) != 0) { int e = errno; close(fd); return -e; }
+  void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return -errno;
+
+  Header* h = H(base);
+  memset(h, 0, sizeof(Header));
+  h->version = kVersion;
+  h->region_size = size;
+  h->table_capacity = table_capacity;
+  h->table_offset = kHeaderSize;
+  uint64_t table_bytes = align_up(table_capacity * sizeof(Entry), kAlign);
+  h->arena_offset = align_up(kHeaderSize + table_bytes, 4096);
+  h->arena_size = size - h->arena_offset;
+  h->free_head = -1;
+  h->lru_head = h->lru_tail = -1;
+
+  memset(table(base), 0, table_bytes);
+
+  // one giant free block
+  BlockHeader* b = block_at(base, 0);
+  b->size = h->arena_size & ~(kAlign - 1);
+  b->prev_size = 0;
+  b->free_ = 0;
+  freelist_insert(base, b);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->cond, &ca);
+
+  h->magic = kMagic;
+  msync(base, kHeaderSize, MS_SYNC);
+  munmap(base, size);
+  return 0;
+}
+
+// Attach: mmap the file; returns base pointer or NULL. size written to *size_out.
+void* rt_store_attach(const char* path, uint64_t* size_out) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  if (H(base)->magic != kMagic) { munmap(base, (size_t)st.st_size); return nullptr; }
+  if (size_out) *size_out = (uint64_t)st.st_size;
+  return base;
+}
+
+int rt_store_detach(void* base) {
+  return munmap(base, (size_t)H(base)->region_size);
+}
+
+// Allocate an object slot. Returns data offset (from region base) or:
+//  -1 = out of memory (even after eviction), -2 = already exists, -3 = table full
+int64_t rt_store_create(void* base, const uint8_t* id, uint64_t data_size) {
+  Header* h = H(base);
+  lock(h);
+  Entry* existing = find_entry(base, id, false);
+  if (existing && existing->state != ENTRY_TOMBSTONE) { unlock(h); return -2; }
+  int64_t off = arena_alloc(base, data_size ? data_size : 1);
+  if (off < 0) {
+    evict_for(base, data_size);
+    off = arena_alloc(base, data_size ? data_size : 1);
+  }
+  if (off < 0) { unlock(h); return -1; }
+  Entry* e = find_entry(base, id, true);
+  if (!e) { arena_free(base, off); unlock(h); return -3; }
+  memcpy(e->id, id, 16);
+  e->state = ENTRY_CREATED;
+  e->flags = 0;
+  e->offset = (uint64_t)off + h->arena_offset;  // offset from region base
+  e->data_size = data_size;
+  e->refcount = 1;  // creator holds a ref until seal+release
+  e->lru_prev = e->lru_next = -1;
+  e->seq = h->seq_counter++;
+  h->num_objects++;
+  unlock(h);
+  return (int64_t)e->offset;
+}
+
+int rt_store_seal(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  Entry* e = find_entry(base, id, false);
+  if (!e || e->state != ENTRY_CREATED) { unlock(h); return -1; }
+  e->state = ENTRY_SEALED;
+  pthread_cond_broadcast(&h->cond);
+  unlock(h);
+  return 0;
+}
+
+// Get: waits up to timeout_s for the object to be sealed. On success increments
+// refcount and returns data offset; *size_out = data size.
+// Returns -1 on timeout, -2 if absent and timeout==0.
+int64_t rt_store_get(void* base, const uint8_t* id, uint64_t* size_out,
+                     double timeout_s) {
+  Header* h = H(base);
+  bool have_deadline = timeout_s > 0;
+  timespec deadline = have_deadline ? deadline_after(timeout_s) : timespec{};
+  lock(h);
+  for (;;) {
+    Entry* e = find_entry(base, id, false);
+    if (e && e->state == ENTRY_SEALED) {
+      if (e->refcount == 0) lru_remove(base, e);
+      e->refcount++;
+      if (size_out) *size_out = e->data_size;
+      int64_t off = (int64_t)e->offset;
+      unlock(h);
+      return off;
+    }
+    if (!have_deadline) { unlock(h); return e ? -1 : -2; }
+    int rc = pthread_cond_timedwait(&h->cond, &h->mutex, &deadline);
+    if (rc == ETIMEDOUT) { unlock(h); return -1; }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mutex);
+  }
+}
+
+int rt_store_release(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  Entry* e = find_entry(base, id, false);
+  if (!e || e->state == ENTRY_TOMBSTONE || e->refcount <= 0) { unlock(h); return -1; }
+  e->refcount--;
+  if (e->refcount == 0) {
+    if (e->flags & 1) delete_entry_locked(base, e);
+    else if (e->state == ENTRY_SEALED) lru_push_tail(base, e);
+  }
+  unlock(h);
+  return 0;
+}
+
+// Abort a created-but-unsealed object (creator failed mid-write).
+int rt_store_abort(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  Entry* e = find_entry(base, id, false);
+  if (!e || e->state != ENTRY_CREATED) { unlock(h); return -1; }
+  delete_entry_locked(base, e);
+  unlock(h);
+  return 0;
+}
+
+// Delete: frees now if refcount==0, else marks delete-pending.
+int rt_store_delete(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  Entry* e = find_entry(base, id, false);
+  if (!e || e->state == ENTRY_TOMBSTONE) { unlock(h); return -1; }
+  if (e->refcount == 0) delete_entry_locked(base, e);
+  else e->flags |= 1;
+  unlock(h);
+  return 0;
+}
+
+// 1 if sealed, 0 if absent/unsealed.
+int rt_store_contains(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  Entry* e = find_entry(base, id, false);
+  int r = (e && e->state == ENTRY_SEALED) ? 1 : 0;
+  unlock(h);
+  return r;
+}
+
+void rt_store_stats(void* base, uint64_t* bytes_allocated, uint64_t* arena_size,
+                    uint64_t* num_objects, uint64_t* num_evictions) {
+  Header* h = H(base);
+  lock(h);
+  if (bytes_allocated) *bytes_allocated = h->bytes_allocated;
+  if (arena_size) *arena_size = h->arena_size;
+  if (num_objects) *num_objects = h->num_objects;
+  if (num_evictions) *num_evictions = h->num_evictions;
+  unlock(h);
+}
+
+}  // extern "C"

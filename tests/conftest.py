@@ -1,0 +1,33 @@
+import os
+
+# Force CPU with 8 virtual devices BEFORE jax import anywhere in tests.
+# (Parity with reference test strategy: fake resources / simulated multi-node,
+# SURVEY.md §4 — JAX-side tests use host-platform virtual devices.)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_store(tmp_path):
+    from ray_tpu._private.object_store import SharedMemoryStore
+
+    store = SharedMemoryStore.create(str(tmp_path / "store"), 64 * 1024 * 1024)
+    yield store
+    store.close()
+
+
+@pytest.fixture
+def rt():
+    """A running single-node cluster, shut down after the test."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()

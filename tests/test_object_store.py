@@ -1,0 +1,120 @@
+"""Native shared-memory store tests (parity: reference plasma store tests,
+src/ray/object_manager/test/)."""
+
+import multiprocessing
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private import serialization
+from ray_tpu._private.object_store import (
+    ObjectExistsError,
+    SharedMemoryStore,
+    StoreFullError,
+)
+
+
+def test_put_get_roundtrip(tmp_store):
+    oid = ObjectID.for_put()
+    data = b"hello world" * 100
+    tmp_store.put(oid, data)
+    view = tmp_store.get(oid)
+    assert bytes(view) == data
+    tmp_store.release(oid)
+
+
+def test_get_missing_returns_none(tmp_store):
+    assert tmp_store.get(ObjectID.for_put()) is None
+
+
+def test_contains_and_delete(tmp_store):
+    oid = ObjectID.for_put()
+    assert not tmp_store.contains(oid)
+    tmp_store.put(oid, b"x")
+    assert tmp_store.contains(oid)
+    tmp_store.delete(oid)
+    assert not tmp_store.contains(oid)
+
+
+def test_duplicate_create_raises(tmp_store):
+    oid = ObjectID.for_put()
+    tmp_store.put(oid, b"x")
+    with pytest.raises(ObjectExistsError):
+        tmp_store.create_buffer(oid, 10)
+
+
+def test_zero_copy_numpy_roundtrip(tmp_store):
+    oid = ObjectID.for_put()
+    arr = np.arange(100000, dtype=np.float32).reshape(100, 1000)
+    meta, views, total = serialization.packed_size(arr)
+    buf = tmp_store.create_buffer(oid, total)
+    serialization.pack_into(meta, views, buf)
+    tmp_store.seal(oid)
+    out_view = tmp_store.get(oid)
+    out = serialization.unpack(out_view)
+    np.testing.assert_array_equal(out, arr)
+    # zero-copy: the array's buffer lives inside the shm mapping
+    assert not out.flags["OWNDATA"]
+    del out, out_view
+    tmp_store.release(oid)
+    tmp_store.release(oid)  # creator's ref
+
+
+def test_eviction_under_pressure(tmp_path):
+    store = SharedMemoryStore.create(str(tmp_path / "s"), 8 * 1024 * 1024)
+    try:
+        chunk = b"z" * (1024 * 1024)
+        oids = []
+        for _ in range(20):  # 20MB into an 8MB store: LRU eviction must kick in
+            oid = ObjectID.for_put()
+            store.put(oid, chunk)
+            oids.append(oid)
+        stats = store.stats()
+        assert stats["num_evictions"] > 0
+        # newest object still present
+        assert store.contains(oids[-1])
+    finally:
+        store.close()
+
+
+def test_store_full_with_pinned_objects(tmp_path):
+    store = SharedMemoryStore.create(str(tmp_path / "s"), 8 * 1024 * 1024)
+    try:
+        held = []
+        oid0 = ObjectID.for_put()
+        store.put(oid0, b"z" * (4 * 1024 * 1024))
+        held.append(store.get(oid0))  # pin it
+        with pytest.raises(StoreFullError):
+            oid1 = ObjectID.for_put()
+            buf = store.create_buffer(oid1, 6 * 1024 * 1024)
+            del buf
+        for v in held:
+            v.release()
+    finally:
+        store.close()
+
+
+def _child_put(path, oid_bin):
+    store = SharedMemoryStore.attach(path)
+    store.put(ObjectID(oid_bin), b"from-child" * 1000)
+    store.close()
+
+
+def test_cross_process_get_blocks_until_seal(tmp_path):
+    path = str(tmp_path / "s")
+    store = SharedMemoryStore.create(path, 32 * 1024 * 1024)
+    try:
+        oid = ObjectID.for_put()
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_child_put, args=(path, oid.binary()))
+        t0 = time.monotonic()
+        p.start()
+        view = store.get(oid, timeout=30)
+        assert view is not None
+        assert bytes(view[:10]) == b"from-child"
+        p.join()
+        assert time.monotonic() - t0 < 30
+    finally:
+        store.close()
