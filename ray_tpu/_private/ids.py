@@ -11,7 +11,6 @@ prefix + 4-byte big-endian index.
 from __future__ import annotations
 
 import os
-import threading
 
 _UNIQUE_LEN = 16
 _TASK_PREFIX_LEN = 12
@@ -89,9 +88,6 @@ class PlacementGroupID(BaseID):
 
 class TaskID(BaseID):
     """Task IDs: 12 random/derived bytes + 4 zero bytes (so ObjectIDs can embed them)."""
-
-    _counter = 0
-    _lock = threading.Lock()
 
     @classmethod
     def for_task(cls) -> "TaskID":

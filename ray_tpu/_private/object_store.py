@@ -171,7 +171,8 @@ class SharedMemoryStore:
         )
         if off < 0:
             return None
-        return self._view[off : off + size.value]
+        # Sealed objects are immutable: hand out a read-only view.
+        return self._view[off : off + size.value].toreadonly()
 
     def release(self, oid: ObjectID):
         self._lib.rt_store_release(self._base, oid.binary())

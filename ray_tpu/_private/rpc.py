@@ -162,10 +162,13 @@ class Connection:
         seqno = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
-        await self._send(_REQUEST, seqno, method, data)
-        if timeout is not None:
-            return await asyncio.wait_for(fut, timeout)
-        return await fut
+        try:
+            await self._send(_REQUEST, seqno, method, data)
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(seqno, None)
 
     async def notify_async(self, method: str, data: Any):
         await self._send(_NOTIFY, None, method, data)

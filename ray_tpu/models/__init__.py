@@ -1,0 +1,11 @@
+"""Model zoo (flagship: decoder-only LM mirroring the reference's GPT-J-6B
+north-star workload — BASELINE.md — built functional-JAX with logical-axis
+sharding annotations for dp/pp/ep/sp/tp meshes)."""
+
+from ray_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_logical_axes,
+)

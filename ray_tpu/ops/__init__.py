@@ -1,0 +1,4 @@
+"""Hot compute ops: attention (dense / ring / pallas-flash), norms, MoE routing."""
+
+from ray_tpu.ops.attention import causal_attention  # noqa: F401
+from ray_tpu.ops.ring_attention import ring_attention  # noqa: F401

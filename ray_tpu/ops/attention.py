@@ -1,0 +1,80 @@
+"""Dense causal multi-head attention (reference implementation).
+
+The all-jnp path: XLA fuses the softmax chain and tiles the two matmuls onto
+the MXU. Used when the sequence axis is unsharded; `ring_attention` (sp>1) and
+the Pallas flash kernel (long single-device sequences) build on the same
+blockwise log-sum-exp accumulation primitives defined here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] (grouped-query attention)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def causal_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    *,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+    causal: bool = True,
+) -> jax.Array:
+    """Standard softmax attention with a causal mask on global positions.
+
+    q_offset/kv_offset give the global position of element 0 of each block so
+    the same function serves full sequences and ring/blockwise shards.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def blockwise_update(
+    scores: jax.Array,  # [B, H, Sq, Skblk] fp32, already masked
+    v_blk: jax.Array,  # [B, Skblk, H, D]
+    acc: jax.Array,  # [B, Sq, H, D] fp32 running numerator
+    m: jax.Array,  # [B, H, Sq] running row max
+    l: jax.Array,  # [B, H, Sq] running denominator
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One flash-attention accumulation step (online softmax)."""
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def blockwise_finalize(acc: jax.Array, l: jax.Array, dtype) -> jax.Array:
+    """acc [B, Sq, H, D], l [B, H, Sq] -> normalized output in `dtype`."""
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(dtype)

@@ -1,0 +1,17 @@
+"""TPU-native parallelism layer: device meshes, sharding rules, collectives.
+
+This is the subsystem the reference lacks natively (SURVEY.md §2.5: TP/PP/SP/EP
+are absent or external integrations in Ray — DeepSpeed/Alpa release tests only).
+Here every parallelism strategy is a first-class named mesh axis lowered to XLA
+collectives over ICI, per the GSPMD model: pick a mesh, annotate shardings, let
+XLA insert collectives.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    AxisRules,
+    DEFAULT_RULES,
+    build_mesh,
+    logical_to_spec,
+    shardings_for,
+)

@@ -1,0 +1,144 @@
+"""Device mesh construction + logical-axis sharding rules.
+
+Design: a single global ``jax.sharding.Mesh`` with up to five named axes —
+``dp`` (data), ``pp`` (pipeline stage), ``ep`` (expert), ``sp`` (sequence /
+context), ``tp`` (tensor) — in that order, so that the innermost (fastest
+ICI neighbourhood) axis is ``tp`` where the heaviest collectives live.
+Parameters and activations are annotated with *logical* axis names
+('vocab', 'embed', 'mlp', 'heads', 'batch', 'seq', 'experts', ...) and an
+``AxisRules`` table maps logical names onto mesh axes, flax-partitioning
+style.  This replaces the reference's external integrations for model
+parallelism (SURVEY.md §2.5: reference ships DP only; TP/PP/SP/EP absent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of the five mesh axes. Product must equal the device count.
+
+    Any axis left at -1 absorbs the remaining devices (at most one).
+    """
+
+    dp: int = -1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        sizes = [self.dp, self.pp, self.ep, self.sp, self.tp]
+        free = [i for i, s in enumerate(sizes) if s == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if free:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[free[0]] = n_devices // fixed
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(MESH_AXES, sizes))} != {n_devices} devices"
+            )
+        return tuple(sizes)
+
+
+def build_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+MeshAxis = Union[str, Tuple[str, ...], None]
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisRules:
+    """Logical axis name -> mesh axis (or tuple of mesh axes, or None=replicate)."""
+
+    rules: Tuple[Tuple[str, MeshAxis], ...]
+
+    def lookup(self, logical: Optional[str]) -> MeshAxis:
+        if logical is None:
+            return None
+        for name, axis in self.rules:
+            if name == logical:
+                return axis
+        return None
+
+    def with_overrides(self, **overrides: MeshAxis) -> "AxisRules":
+        table = dict(self.rules)
+        table.update(overrides)
+        return AxisRules(tuple(table.items()))
+
+
+# Default rules: megatron-style TP for vocab/mlp/heads, batch over (dp, ep)
+# — expert parallelism reuses the batch dimension for routing all-to-all —
+# sequence over sp, layer-stack over pp (pipeline stages).  'embed' left
+# replicated by default; FSDP-style setups override it to ('dp',) to shard
+# parameters/optimizer state ZeRO-style (GSPMD all-gathers them per layer).
+DEFAULT_RULES = AxisRules(
+    (
+        ("batch", ("dp", "ep")),
+        ("seq", "sp"),
+        ("vocab", "tp"),
+        ("embed", None),
+        ("mlp", "tp"),
+        ("heads", "tp"),
+        ("kv_heads", "tp"),
+        ("head_dim", None),
+        ("experts", "ep"),
+        ("layers", "pp"),
+        ("stage", "pp"),
+    )
+)
+
+FSDP_RULES = DEFAULT_RULES.with_overrides(embed=("dp",))
+
+
+def logical_to_spec(rules: AxisRules, logical_axes: Sequence[Optional[str]]) -> P:
+    return P(*(rules.lookup(a) for a in logical_axes))
+
+
+def shardings_for(mesh: Mesh, rules: AxisRules, logical_tree):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(rules, axes)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name]
+
+
+def host_local_mesh(n: int = 0) -> Mesh:
+    """Mesh over this host's devices only (single-host DP/TP testing)."""
+    devs = jax.local_devices()
+    if n:
+        devs = devs[:n]
+    return build_mesh(MeshConfig(dp=len(devs)), devices=devs)

@@ -1,0 +1,138 @@
+"""Sharded train-state construction + jitted train step.
+
+The GSPMD recipe: resolve every parameter's logical axes to a NamedSharding,
+jit the init so parameters are *born sharded* (no host round-trip), and jit
+the update with donated state so optimizer memory is reused in-place. This is
+the TPU replacement for the reference's DeepSpeed/NCCL data-parallel stack
+(ray/train/torch/config.py): gradients are reduced by XLA collectives that
+the partitioner inserts from the sharding annotations — there is no
+hand-written allreduce anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.parallel.mesh import AxisRules, DEFAULT_RULES, logical_to_spec, shardings_for
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten
+)
+
+
+def batch_sharding(mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> NamedSharding:
+    """Tokens/targets [B, S]: batch over dp(+ep), sequence over sp."""
+    return NamedSharding(mesh, logical_to_spec(rules, ("batch", "seq")))
+
+
+def make_sharded_state(
+    config: TransformerConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    rules: AxisRules = DEFAULT_RULES,
+) -> Tuple[TrainState, Any]:
+    """Returns (state, state_shardings); params/opt-state born sharded."""
+    logical = param_logical_axes(config)
+    param_sh = shardings_for(mesh, rules, logical)
+
+    def init(rng):
+        params = init_params(config, rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    # Optimizer state shardings: any subtree that mirrors the params tree
+    # (adam mu/nu) gets the params' shardings; everything else replicates.
+    abstract = jax.eval_shape(init, rng)
+    replicated = NamedSharding(mesh, P())
+    params_struct = jax.tree.structure(abstract.params)
+
+    def is_params_like(sub):
+        try:
+            return jax.tree.structure(sub) == params_struct
+        except Exception:
+            return False
+
+    opt_sh = jax.tree.map(
+        lambda sub: param_sh
+        if is_params_like(sub)
+        else jax.tree.map(lambda _: replicated, sub),
+        abstract.opt_state,
+        is_leaf=is_params_like,
+    )
+    state_sh = TrainState(step=replicated, params=param_sh, opt_state=opt_sh)
+    state = jax.jit(init, out_shardings=state_sh)(rng)
+    return state, state_sh
+
+
+def make_train_step(
+    config: TransformerConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    state_shardings: Any,
+    rules: AxisRules = DEFAULT_RULES,
+    loss: Callable = loss_fn,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
+    """Jitted, donated train step: (state, batch) -> (state, metrics)."""
+    data_sh = batch_sharding(mesh, rules)
+
+    def step_fn(state: TrainState, batch):
+        loss_val, grads = jax.value_and_grad(loss)(
+            state.params, batch, config, mesh
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss_val,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step + 1,
+        }
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    batch_spec = {k: data_sh for k in ("tokens", "targets", "mask")}
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_spec),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )

@@ -271,19 +271,17 @@ void delete_entry_locked(void* base, Entry* e) {
   h->num_objects--;
 }
 
-// Evict LRU sealed refcount-0 objects until `needed` bytes could be free.
-// Returns true if anything was evicted.
-bool evict_for(void* base, uint64_t needed) {
+// Evict the single least-recently-used sealed refcount-0 object.
+// Returns true if something was evicted. Callers retry allocation after each
+// eviction: total free bytes do not imply a large-enough *contiguous* block,
+// so evicting one victim at a time (with coalescing in arena_free) until the
+// allocation succeeds is the correct policy.
+bool evict_one(void* base) {
   Header* h = H(base);
-  bool any = false;
-  while (h->lru_head >= 0 &&
-         h->arena_size - h->bytes_allocated < needed + sizeof(BlockHeader) + kAlign) {
-    Entry* victim = &table(base)[h->lru_head];
-    delete_entry_locked(base, victim);
-    h->num_evictions++;
-    any = true;
-  }
-  return any;
+  if (h->lru_head < 0) return false;
+  delete_entry_locked(base, &table(base)[h->lru_head]);
+  h->num_evictions++;
+  return true;
 }
 
 timespec deadline_after(double seconds) {
@@ -315,8 +313,16 @@ int rt_store_init(const char* path, uint64_t size, uint64_t table_capacity) {
   h->table_capacity = table_capacity;
   h->table_offset = kHeaderSize;
   uint64_t table_bytes = align_up(table_capacity * sizeof(Entry), kAlign);
-  h->arena_offset = align_up(kHeaderSize + table_bytes, 4096);
-  h->arena_size = size - h->arena_offset;
+  uint64_t arena_offset = align_up(kHeaderSize + table_bytes, 4096);
+  // The region must fit header + table + at least one minimal block.
+  if (arena_offset + sizeof(BlockHeader) + kAlign > size) {
+    munmap(base, size);
+    return -EINVAL;
+  }
+  h->arena_offset = arena_offset;
+  // Keep arena_size itself kAlign-aligned so block walks (right_neighbor
+  // bound checks) agree exactly with the initial free block's extent.
+  h->arena_size = (size - arena_offset) & ~(kAlign - 1);
   h->free_head = -1;
   h->lru_head = h->lru_tail = -1;
 
@@ -324,7 +330,7 @@ int rt_store_init(const char* path, uint64_t size, uint64_t table_capacity) {
 
   // one giant free block
   BlockHeader* b = block_at(base, 0);
-  b->size = h->arena_size & ~(kAlign - 1);
+  b->size = h->arena_size;
   b->prev_size = 0;
   b->free_ = 0;
   freelist_insert(base, b);
@@ -372,12 +378,15 @@ int64_t rt_store_create(void* base, const uint8_t* id, uint64_t data_size) {
   Entry* existing = find_entry(base, id, false);
   if (existing && existing->state != ENTRY_TOMBSTONE) { unlock(h); return -2; }
   int64_t off = arena_alloc(base, data_size ? data_size : 1);
-  if (off < 0) {
-    evict_for(base, data_size);
+  while (off < 0 && evict_one(base)) {
     off = arena_alloc(base, data_size ? data_size : 1);
   }
   if (off < 0) { unlock(h); return -1; }
   Entry* e = find_entry(base, id, true);
+  // Table full: evict LRU objects (tombstoning their slots) to make room.
+  while (!e && evict_one(base)) {
+    e = find_entry(base, id, true);
+  }
   if (!e) { arena_free(base, off); unlock(h); return -3; }
   memcpy(e->id, id, 16);
   e->state = ENTRY_CREATED;

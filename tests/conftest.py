@@ -3,13 +3,18 @@ import os
 # Force CPU with 8 virtual devices BEFORE jax import anywhere in tests.
 # (Parity with reference test strategy: fake resources / simulated multi-node,
 # SURVEY.md §4 — JAX-side tests use host-platform virtual devices.)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU site hook pins jax_platforms at import; force CPU for tests.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

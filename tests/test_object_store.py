@@ -118,3 +118,54 @@ def test_cross_process_get_blocks_until_seal(tmp_path):
         assert time.monotonic() - t0 < 30
     finally:
         store.close()
+
+
+def test_table_full_evicts_lru(tmp_path):
+    """More sealed refcount-0 objects than table slots: LRU slots are evicted
+    rather than failing with a table-full error."""
+    store = SharedMemoryStore.create(
+        str(tmp_path / "s"), 16 * 1024 * 1024, table_capacity=64
+    )
+    try:
+        for i in range(200):  # > capacity; all sealed + released
+            store.put(ObjectID.for_put(), b"x" * 128)
+        assert store.stats()["num_evictions"] > 0
+    finally:
+        store.close()
+
+
+def test_eviction_frees_contiguous_space(tmp_path):
+    """Allocation retries after each single eviction, so fragmented-but-
+    evictable stores still satisfy large creates."""
+    store = SharedMemoryStore.create(str(tmp_path / "s"), 8 * 1024 * 1024)
+    try:
+        # Fill with ~6MB of adjacent 1MB sealed objects.
+        for _ in range(6):
+            store.put(ObjectID.for_put(), b"y" * (1024 * 1024))
+        # A 4MB create must evict enough *adjacent* victims to coalesce.
+        big = ObjectID.for_put()
+        buf = store.create_buffer(big, 4 * 1024 * 1024)
+        del buf
+        store.abort(big)
+    finally:
+        store.close()
+
+
+def test_tiny_region_rejected(tmp_path):
+    with pytest.raises(OSError):
+        SharedMemoryStore.create(str(tmp_path / "s"), 64 * 1024,
+                                 table_capacity=1024)
+
+
+def test_get_view_is_readonly(tmp_path):
+    store = SharedMemoryStore.create(str(tmp_path / "s"), 8 * 1024 * 1024)
+    try:
+        oid = ObjectID.for_put()
+        store.put(oid, b"immutable")
+        view = store.get(oid)
+        assert view.readonly
+        with pytest.raises(TypeError):
+            view[0] = 0
+        store.release(oid)
+    finally:
+        store.close()
