@@ -1,0 +1,446 @@
+"""GCS: the head-node control plane (Global Control Service).
+
+Parity: reference ``src/ray/gcs/gcs_server/`` — node membership
+(gcs_node_manager.h:43), actor lifecycle FSM with max_restarts
+(gcs_actor_manager.h:281, restart at gcs_actor_manager.cc:1117), internal KV
+(gcs_kv_manager.h:101), function/code storage (gcs_function_manager.h:30),
+job table (gcs_job_manager.h:41), health checking
+(gcs_health_check_manager.h:39), pubsub publisher (src/ray/pubsub/).
+
+Redesigns (TPU build): one asyncio loop instead of asio; push-based pubsub
+over the persistent RPC connections instead of long-poll; actor placement is
+delegated to the chosen raylet ("CreateActor" RPC) instead of GCS leasing
+workers itself — the raylet owns its worker pool either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.protocol import NodeInfo, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+# Actor FSM states (parity: rpc::ActorTableData::ActorState)
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorRecord:
+    __slots__ = (
+        "actor_id", "spec", "state", "address", "num_restarts",
+        "restarts_left", "name", "death_cause", "owner_addr",
+    )
+
+    def __init__(self, actor_id: bytes, spec: Dict, name: str = ""):
+        self.actor_id = actor_id
+        self.spec = spec  # TaskSpec wire dict of the creation task
+        self.state = PENDING
+        self.address: Optional[List] = None  # Address wire
+        self.num_restarts = 0
+        self.restarts_left = spec.get("max_restarts", 0)
+        self.name = name
+        self.death_cause = ""
+        self.owner_addr = spec.get("owner")
+
+    def to_wire(self):
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "num_restarts": self.num_restarts,
+            "name": self.name,
+            "death_cause": self.death_cause,
+        }
+
+
+class GcsServer:
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self.server = rpc.Server(sock_path, rpc.handler_table(self), name="gcs")
+        # tables
+        self.kv: Dict[str, bytes] = {}
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        self.node_heartbeat: Dict[bytes, float] = {}
+        self.node_resources: Dict[bytes, Dict] = {}  # available/total per node
+        self.actors: Dict[bytes, ActorRecord] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.jobs: Dict[bytes, Dict] = {}
+        # pubsub: channel -> set of connections
+        self.subs: Dict[str, Set[rpc.Connection]] = {}
+        self._raylet_clients: Dict[bytes, rpc.Connection] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._started = asyncio.Event()
+
+    # ---------------- lifecycle ----------------
+    async def start(self):
+        await self.server.start_async()
+        self._health_task = asyncio.get_running_loop().create_task(
+            self._health_loop()
+        )
+        self._started.set()
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop_async()
+
+    # ---------------- pubsub ----------------
+    def _publish(self, channel: str, data: Any):
+        dead = []
+        for conn in self.subs.get(channel, ()):
+            if conn.closed:
+                dead.append(conn)
+                continue
+            asyncio.get_running_loop().create_task(
+                conn.notify_async("publish", [channel, data])
+            )
+        for c in dead:
+            self.subs.get(channel, set()).discard(c)
+
+    async def rpc_subscribe(self, conn, channels: List[str]):
+        for ch in channels:
+            self.subs.setdefault(ch, set()).add(conn)
+        # Snapshot semantics: subscriber immediately gets current state of
+        # snapshot-able channels so subscribe-then-read races can't drop data.
+        snap = {}
+        for ch in channels:
+            if ch == "nodes":
+                snap[ch] = [n.to_wire() for n in self.nodes.values()]
+            elif ch == "actors":
+                snap[ch] = [a.to_wire() for a in self.actors.values()]
+            elif ch == "resources":
+                snap[ch] = self._resource_view()
+        return snap
+
+    # ---------------- KV (function table etc.) ----------------
+    async def rpc_kv_put(self, conn, data):
+        key, value, overwrite = data
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = value
+        return True
+
+    async def rpc_kv_get(self, conn, key):
+        return self.kv.get(key)
+
+    async def rpc_kv_del(self, conn, key):
+        return self.kv.pop(key, None) is not None
+
+    async def rpc_kv_exists(self, conn, key):
+        return key in self.kv
+
+    async def rpc_kv_keys(self, conn, prefix):
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---------------- nodes ----------------
+    async def rpc_register_node(self, conn, info_wire):
+        info = NodeInfo.from_wire(info_wire)
+        self.nodes[info.node_id] = info
+        self.node_heartbeat[info.node_id] = time.monotonic()
+        conn.on_close = self._make_node_close_handler(info.node_id)
+        self._raylet_clients[info.node_id] = conn
+        logger.info("node registered: %s", info.node_id.hex()[:12])
+        self._publish("nodes", [info.to_wire()])
+        return {"node_id": info.node_id, "config": GLOBAL_CONFIG.dump()}
+
+    def _make_node_close_handler(self, node_id: bytes):
+        def on_close(conn):
+            # Raylet connection dropped => node presumed dead.
+            asyncio.get_running_loop().create_task(self._mark_node_dead(node_id))
+
+        return on_close
+
+    async def rpc_heartbeat(self, conn, data):
+        node_id, resources = data
+        self.node_heartbeat[node_id] = time.monotonic()
+        if resources:
+            self.node_resources[node_id] = resources
+            self._publish("resources", self._resource_view())
+        return True
+
+    async def rpc_get_all_nodes(self, conn, _):
+        return [n.to_wire() for n in self.nodes.values()]
+
+    def _resource_view(self):
+        return {
+            nid.hex(): res
+            for nid, res in self.node_resources.items()
+            if nid in self.nodes and self.nodes[nid].alive
+        }
+
+    async def _mark_node_dead(self, node_id: bytes):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        logger.warning("node dead: %s", node_id.hex()[:12])
+        self._raylet_clients.pop(node_id, None)
+        self.node_resources.pop(node_id, None)
+        self._publish("nodes", [info.to_wire()])
+        self._publish("resources", self._resource_view())
+        # Actors on that node die (and maybe restart elsewhere).
+        for rec in list(self.actors.values()):
+            if rec.address and rec.address[2] == node_id and rec.state in (
+                ALIVE, PENDING, RESTARTING,
+            ):
+                await self._on_actor_death(rec, f"node {node_id.hex()[:12]} died")
+
+    async def _health_loop(self):
+        period = GLOBAL_CONFIG.health_check_period_ms / 1e3
+        timeout = GLOBAL_CONFIG.health_check_timeout_ms / 1e3
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for nid, last in list(self.node_heartbeat.items()):
+                info = self.nodes.get(nid)
+                if info is not None and info.alive and now - last > timeout:
+                    await self._mark_node_dead(nid)
+
+    # ---------------- jobs ----------------
+    async def rpc_register_job(self, conn, data):
+        job_id, meta = data
+        self.jobs[job_id] = dict(meta, start_time=time.time())
+        return True
+
+    async def rpc_get_jobs(self, conn, _):
+        return {k.hex(): v for k, v in self.jobs.items()}
+
+    # ---------------- actors ----------------
+    async def rpc_create_actor(self, conn, data):
+        """Register + asynchronously place an actor. Returns immediately."""
+        spec = data
+        actor_id = spec["actor_id"]
+        name = spec.get("name_register") or ""
+        if name:
+            if name in self.named_actors:
+                return {"ok": False, "error": f"actor name {name!r} taken"}
+            self.named_actors[name] = actor_id
+        rec = ActorRecord(actor_id, spec, name=name)
+        self.actors[actor_id] = rec
+        asyncio.get_running_loop().create_task(self._place_actor(rec))
+        return {"ok": True}
+
+    def _pick_node_for(self, resources: Dict[str, float]) -> Optional[bytes]:
+        """Pack-biased placement using the latest resource view."""
+        best, best_avail = None, -1.0
+        for nid, info in self.nodes.items():
+            if not info.alive:
+                continue
+            avail = self.node_resources.get(nid, {}).get("available", {})
+            if all(avail.get(r, 0.0) >= q for r, q in resources.items()):
+                score = sum(avail.values())
+                if best is None or score < best_avail:
+                    best, best_avail = nid, score
+        if best is None:
+            # fall back to any alive node that *totals* enough (queue there)
+            for nid, info in self.nodes.items():
+                total = self.node_resources.get(nid, {}).get("total", {})
+                if info.alive and all(
+                    total.get(r, 0.0) >= q for r, q in resources.items()
+                ):
+                    return nid
+        return best
+
+    async def _place_actor(self, rec: ActorRecord, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        spec = rec.spec
+        deadline = time.monotonic() + 60.0
+        while rec.state in (PENDING, RESTARTING):
+            node_id = self._pick_node_for(spec.get("resources") or {})
+            raylet = self._raylet_clients.get(node_id) if node_id else None
+            if raylet is None or raylet.closed:
+                if time.monotonic() > deadline:
+                    await self._fail_actor(rec, "no node can host this actor")
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                reply = await raylet.call_async("create_actor", spec, timeout=120)
+            except Exception as e:
+                logger.warning("actor placement on %s failed: %s",
+                               node_id.hex()[:12], e)
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("ok"):
+                if rec.state == DEAD:
+                    # killed while placing: reap the freshly-created worker
+                    try:
+                        await raylet.call_async(
+                            "kill_worker",
+                            [reply["address"][0], rec.actor_id],
+                            timeout=10,
+                        )
+                    except Exception:
+                        pass
+                    return
+                rec.address = reply["address"]
+                rec.state = ALIVE
+                self._publish("actors", [rec.to_wire()])
+                return
+            logger.warning("actor %s placement rejected: %s",
+                           rec.actor_id.hex()[:12], reply.get("error"))
+            if reply.get("fatal"):
+                await self._fail_actor(rec, reply.get("error", "creation failed"))
+                return
+            if time.monotonic() > deadline:
+                await self._fail_actor(rec, reply.get("error", "placement failed"))
+                return
+            await asyncio.sleep(0.2)
+
+    async def _fail_actor(self, rec: ActorRecord, reason: str):
+        rec.state = DEAD
+        rec.death_cause = reason
+        if rec.name:
+            self.named_actors.pop(rec.name, None)
+        self._publish("actors", [rec.to_wire()])
+
+    async def _on_actor_death(self, rec: ActorRecord, reason: str):
+        if rec.state == DEAD:
+            return
+        if rec.restarts_left != 0:
+            if rec.restarts_left > 0:
+                rec.restarts_left -= 1
+            rec.num_restarts += 1
+            rec.state = RESTARTING
+            rec.address = None
+            self._publish("actors", [rec.to_wire()])
+            logger.info("restarting actor %s (%d restarts)",
+                        rec.actor_id.hex()[:12], rec.num_restarts)
+            await self._place_actor(rec)
+        else:
+            rec.death_cause = reason
+            await self._fail_actor(rec, reason)
+
+    async def rpc_report_actor_death(self, conn, data):
+        """Raylet reports an actor worker exited."""
+        actor_id, reason, expected = data
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        if expected:  # ray.kill(no_restart) / actor __exit__
+            await self._fail_actor(rec, reason or "actor exited")
+        else:
+            await self._on_actor_death(rec, reason or "worker died")
+        return True
+
+    async def rpc_kill_actor(self, conn, data):
+        actor_id, no_restart = data
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        if no_restart:
+            rec.restarts_left = 0
+        if rec.address is None:
+            # Still placing (PENDING/RESTARTING): mark dead now; _place_actor
+            # checks state and kills a worker that wins the race.
+            if no_restart and rec.state in (PENDING, RESTARTING):
+                await self._fail_actor(rec, "killed via kill_actor")
+            return True
+        # Tell the hosting raylet to SIGKILL the worker.
+        if rec.address is not None:
+            node_id = rec.address[2]
+            raylet = self._raylet_clients.get(node_id)
+            if raylet is not None and not raylet.closed:
+                try:
+                    await raylet.call_async(
+                        "kill_worker", [rec.address[0], actor_id], timeout=10
+                    )
+                except Exception:
+                    pass
+        return True
+
+    async def rpc_get_actor(self, conn, actor_id):
+        rec = self.actors.get(actor_id)
+        return rec.to_wire() if rec else None
+
+    async def rpc_get_named_actor(self, conn, name):
+        aid = self.named_actors.get(name)
+        if aid is None:
+            return None
+        return self.actors[aid].to_wire()
+
+    async def rpc_list_actors(self, conn, _):
+        return [a.to_wire() for a in self.actors.values()]
+
+    # ---------------- object directory ----------------
+    # Locations of plasma objects (node ids). Parity: the reference resolves
+    # locations through owner workers (ownership_based_object_directory.h:37);
+    # here the GCS keeps the directory — simpler, and the owner still drives
+    # lifetime via free_objects.
+    async def rpc_add_object_location(self, conn, data):
+        oid, node_id = data
+        key = "loc:" + oid.hex()
+        locs = self.kv.get(key)
+        locs = set(bytes(l) for l in rpc.msgpack.unpackb(locs)) if locs else set()
+        locs.add(node_id)
+        self.kv[key] = rpc.msgpack.packb([bytes(l) for l in locs])
+        return True
+
+    async def rpc_remove_object_location(self, conn, data):
+        oid, node_id = data
+        key = "loc:" + oid.hex()
+        locs = self.kv.get(key)
+        if locs is None:
+            return False
+        s = set(bytes(l) for l in rpc.msgpack.unpackb(locs))
+        s.discard(node_id)
+        if s:
+            self.kv[key] = rpc.msgpack.packb(sorted(s))
+        else:
+            self.kv.pop(key, None)
+        return True
+
+    async def rpc_get_object_locations(self, conn, oid):
+        locs = self.kv.get("loc:" + oid.hex())
+        return rpc.msgpack.unpackb(locs) if locs else []
+
+    # ---------------- debug ----------------
+    async def rpc_ping(self, conn, _):
+        return "pong"
+
+    async def rpc_internal_state(self, conn, _):
+        return {
+            "num_nodes": len([n for n in self.nodes.values() if n.alive]),
+            "num_actors": len(self.actors),
+            "kv_keys": len(self.kv),
+            "method_stats": rpc.method_stats().snapshot(),
+        }
+
+
+def main():
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--sock")
+    p.add_argument("--config", default="")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[gcs %(asctime)s] %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    if args.config:
+        import json
+
+        GLOBAL_CONFIG.load(json.loads(args.config))
+
+    async def run():
+        gcs = GcsServer(args.sock)
+        await gcs.start()
+        await asyncio.Event().wait()  # serve forever
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""Node bootstrap: session dir + head/worker-node process spawning.
+
+Parity: reference ``python/ray/_private/node.py:37`` (Node), ``services.py``
+(start_gcs_server:1280, start_raylet:1353). A "node" here is one raylet +
+one shared-memory store; the head node also runs the GCS. Multi-node
+simulation on one host = N raylets with faked resources against one GCS
+(the reference's cluster_utils.Cluster trick, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def new_session_dir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "raytpu")
+    os.makedirs(base, exist_ok=True)
+    d = os.path.join(base, f"session_{time.strftime('%H%M%S')}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(d, "logs"), exist_ok=True)
+    os.makedirs(os.path.join(d, "sockets"), exist_ok=True)
+    return d
+
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def clean_env(tpu: bool = False) -> Dict[str, str]:
+    """Env for spawned processes. Site hooks that eagerly initialize TPU
+    plugins cost seconds of python startup; control-plane daemons and plain
+    CPU workers must not pay that. TPU workers keep the full env."""
+    env = dict(os.environ)
+    parts = [p for p in env.get("PYTHONPATH", "").split(":") if p]
+    if not tpu:
+        parts = [p for p in parts if ".axon_site" not in p]
+        if env.get("JAX_PLATFORMS") in ("axon",):
+            env["JAX_PLATFORMS"] = "cpu"
+    if _REPO_ROOT not in parts:
+        parts.append(_REPO_ROOT)
+    env["PYTHONPATH"] = ":".join(parts)
+    return env
+
+
+def _spawn(cmd, log_path) -> subprocess.Popen:
+    out = open(log_path, "wb")
+    proc = subprocess.Popen(
+        cmd, stdout=out, stderr=subprocess.STDOUT, start_new_session=True,
+        env=clean_env(tpu=False),
+    )
+    out.close()
+    return proc
+
+
+def _wait_sock(path: str, timeout=30.0, proc: Optional[subprocess.Popen] = None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"process exited with {proc.returncode} before serving {path}"
+            )
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {path}")
+
+
+class NodeProcs:
+    """One raylet (+store) on this host."""
+
+    def __init__(self, node_id: bytes, proc: subprocess.Popen,
+                 raylet_sock: str, store_path: str):
+        self.node_id = node_id
+        self.proc = proc
+        self.raylet_sock = raylet_sock
+        self.store_path = store_path
+
+    @property
+    def raylet_addr(self):
+        return "unix:" + self.raylet_sock
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
+
+class Cluster:
+    """Head processes: GCS + head raylet; `add_node` fakes extra nodes.
+
+    Parity: reference python/ray/cluster_utils.py Cluster:99/add_node:165.
+    """
+
+    def __init__(self, session_dir: Optional[str] = None):
+        self.session_dir = session_dir or new_session_dir()
+        self.gcs_sock = os.path.join(self.session_dir, "sockets", "gcs.sock")
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.nodes: Dict[bytes, NodeProcs] = {}
+        self.head_node: Optional[NodeProcs] = None
+
+    @property
+    def gcs_addr(self):
+        return "unix:" + self.gcs_sock
+
+    def start_gcs(self, system_config: Optional[Dict] = None):
+        cfg = json.dumps(GLOBAL_CONFIG.dump()) if system_config is None else (
+            json.dumps({**GLOBAL_CONFIG.dump(), **system_config})
+        )
+        self.gcs_proc = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.gcs",
+             "--sock", self.gcs_sock, "--config", cfg],
+            os.path.join(self.session_dir, "logs", "gcs.log"),
+        )
+        _wait_sock(self.gcs_sock, proc=self.gcs_proc)
+
+    def add_node(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+        head: bool = False,
+    ) -> NodeProcs:
+        node_id = NodeID.from_random().binary()
+        hexid = node_id.hex()[:12]
+        raylet_sock = os.path.join(self.session_dir, "sockets", f"raylet-{hexid}.sock")
+        store_path = os.path.join(_SHM_DIR, f"raytpu_{os.getpid()}_{hexid}")
+        resources = dict(resources or {})
+        resources.setdefault("CPU", float(os.cpu_count() or 4))
+        cfg = dict(GLOBAL_CONFIG.dump())
+        if object_store_memory:
+            cfg["object_store_memory_bytes"] = int(object_store_memory)
+        proc = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.raylet",
+             "--sock", raylet_sock,
+             "--store", store_path,
+             "--gcs", self.gcs_addr,
+             "--node-id", node_id.hex(),
+             "--resources", json.dumps(resources),
+             "--labels", json.dumps(labels or {}),
+             "--session-dir", self.session_dir,
+             "--config", json.dumps(cfg)],
+            os.path.join(self.session_dir, "logs", f"raylet-{hexid}.log"),
+        )
+        _wait_sock(raylet_sock, proc=proc)
+        node = NodeProcs(node_id, proc, raylet_sock, store_path)
+        self.nodes[node_id] = node
+        if head:
+            self.head_node = node
+        return node
+
+    def remove_node(self, node: NodeProcs):
+        node.kill()
+        self.nodes.pop(node.node_id, None)
+
+    def shutdown(self):
+        for node in list(self.nodes.values()):
+            node.kill()
+        self.nodes.clear()
+        if self.gcs_proc is not None and self.gcs_proc.poll() is None:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait()
+        self.gcs_proc = None

@@ -1,0 +1,83 @@
+"""ObjectRef: a handle to a (possibly pending) object.
+
+Parity: reference ``ObjectRef`` (python/ray/includes/object_ref.pxi) —
+carries the object id plus the owner's address so any holder can resolve the
+value; registered with the core worker for local reference counting.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, oid: ObjectID, owner: Optional[List] = None):
+        self._id = oid
+        self._owner = owner  # Address wire [worker_id, addr, node_id] or None
+        _on_ref_created(self)
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    @property
+    def owner_address(self):
+        return self._owner
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (_deserialize_ref, (self._id.binary(), self._owner))
+
+    def __del__(self):
+        try:
+            _on_ref_deleted(self)
+        except Exception:
+            pass
+
+    def future(self):
+        """concurrent.futures.Future resolving to the value (asyncio interop)."""
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.core_worker.as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _deserialize_ref(binary: bytes, owner):
+    return ObjectRef(ObjectID(binary), owner)
+
+
+# Reference-count hooks, installed by the core worker when connected.
+def _noop(ref):
+    return None
+
+
+_on_ref_created = _noop
+_on_ref_deleted = _noop
+
+
+def install_ref_hooks(on_created, on_deleted):
+    global _on_ref_created, _on_ref_deleted
+    _on_ref_created = on_created or _noop
+    _on_ref_deleted = on_deleted or _noop

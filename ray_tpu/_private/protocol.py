@@ -1,0 +1,94 @@
+"""Wire-format dataclasses shared by GCS, raylet and workers.
+
+Parity: reference protobuf schemas (src/ray/protobuf/common.proto TaskSpec,
+Address; gcs.proto table data). Here the wire layer is msgpack, so specs are
+plain dicts produced by ``to_wire``/``from_wire``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+
+# Arg encodings inside a TaskSpec:
+#   ("v", packed_bytes)            inline value
+#   ("r", oid_bytes, owner_addr)   object reference
+InlineArg = Tuple[str, bytes]
+
+
+@dataclasses.dataclass
+class Address:
+    """Where to reach a worker's RPC server + who it is."""
+
+    worker_id: bytes
+    addr: str  # "unix:<path>" (or "tcp:host:port" cross-node)
+    node_id: bytes
+
+    def to_wire(self):
+        return [self.worker_id, self.addr, self.node_id]
+
+    @classmethod
+    def from_wire(cls, w):
+        return cls(w[0], w[1], w[2])
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: bytes
+    function_id: bytes  # GCS KV key of the pickled function / actor class
+    job_id: bytes = b""  # namespace of the function table entry
+    name: str = ""
+    args: List[Any] = dataclasses.field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    owner: Optional[List] = None  # Address.to_wire() of the owner
+    # actor fields
+    actor_id: Optional[bytes] = None  # set for actor tasks
+    actor_creation: bool = False  # this task creates the actor
+    method_name: str = ""
+    seq_no: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # scheduling
+    scheduling_strategy: Optional[Any] = None
+    placement_group: Optional[bytes] = None
+    pg_bundle_index: int = -1
+    runtime_env: Optional[Dict] = None
+
+    def to_wire(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, w: Dict) -> "TaskSpec":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in w.items() if k in fields})
+
+    @property
+    def tid(self) -> TaskID:
+        return TaskID(self.task_id)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.from_task(self.tid, i + 1) for i in range(self.num_returns)
+        ]
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    node_id: bytes
+    raylet_addr: str
+    store_path: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    alive: bool = True
+
+    def to_wire(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, w):
+        return cls(**w)

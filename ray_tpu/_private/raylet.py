@@ -1,0 +1,587 @@
+"""Raylet: the per-node daemon — worker pool + lease-based local scheduler.
+
+Parity: reference ``src/ray/raylet/`` — NodeManager lease protocol
+(HandleRequestWorkerLease node_manager.cc:1887), WorkerPool
+(worker_pool.cc:426 StartWorkerProcess, :1141 PopWorker), local/cluster task
+managers (scheduling/cluster_task_manager.h:42, local_task_manager.h:58) and
+the hybrid scheduling policy (policy/hybrid_scheduling_policy.h:50).
+
+Redesigns (TPU build): the object store is an in-process mmap'd arena (no
+store daemon — src/store/store.cpp) created by the raylet and attached by
+every local worker; workers register over the symmetric RPC connection so the
+raylet pushes actor-creation tasks down the same pipe; spillback decisions use
+the GCS-gossiped resource view.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.protocol import NodeInfo
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen]):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[rpc.Connection] = None  # registration connection
+        self.addr: str = ""  # worker's own RPC server address
+        self.lease_id: Optional[bytes] = None
+        self.actor_id: Optional[bytes] = None
+        self.tpu = False  # spawned with TPU runtime env (site hooks intact)
+        self.registered = asyncio.Event()
+
+    @property
+    def alive(self) -> bool:
+        if self.proc is not None and self.proc.poll() is not None:
+            return False
+        return self.conn is not None and not self.conn.closed
+
+
+class Lease:
+    def __init__(self, lease_id: bytes, worker: WorkerHandle, resources: Dict):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+        self.granted_at = time.monotonic()
+
+
+class Raylet:
+    def __init__(
+        self,
+        node_id: bytes,
+        sock_path: str,
+        store_path: str,
+        gcs_addr: str,
+        resources: Dict[str, float],
+        session_dir: str,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = node_id
+        self.sock_path = sock_path
+        self.store_path = store_path
+        self.gcs_addr = gcs_addr
+        self.session_dir = session_dir
+        self.labels = labels or {}
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.server = rpc.Server(sock_path, rpc.handler_table(self), name="raylet")
+        self.store: Optional[SharedMemoryStore] = None
+        self.gcs: Optional[rpc.Connection] = None
+        # workers
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.idle: List[WorkerHandle] = []
+        self.leases: Dict[bytes, Lease] = {}
+        self.drivers: Dict[bytes, rpc.Connection] = {}
+        # lease queue: (spec_summary, future)
+        self.lease_queue: List[Tuple[Dict, asyncio.Future]] = []
+        self.cluster_resources: Dict[str, Dict] = {}  # node hex -> view
+        self.cluster_nodes: Dict[str, Dict] = {}  # node hex -> NodeInfo wire
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+    # ------------- lifecycle -------------
+    async def start(self):
+        size = int(GLOBAL_CONFIG.object_store_memory_bytes)
+        self.store = SharedMemoryStore.create(self.store_path, size)
+        await self.server.start_async()
+        self.gcs = await self._connect_gcs()
+        reply = await self.gcs.call_async(
+            "register_node",
+            NodeInfo(
+                node_id=self.node_id,
+                raylet_addr="unix:" + self.sock_path,
+                store_path=self.store_path,
+                resources=self.total_resources,
+                labels=self.labels,
+            ).to_wire(),
+        )
+        GLOBAL_CONFIG.load(reply["config"])
+        snap = await self.gcs.call_async(
+            "subscribe", ["nodes", "resources"]
+        )
+        for n in snap.get("nodes", []):
+            self._on_nodes_update([n])
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._heartbeat_loop()))
+        if GLOBAL_CONFIG.prestart_workers:
+            n = int(self.total_resources.get("CPU", 1))
+            n = min(n, max(1, (os.cpu_count() or 4)))
+            for _ in range(min(n, 4)):  # cap prestart burst
+                self._start_worker_process()
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        await self.server.stop_async()
+        if self.store is not None:
+            self.store.close()
+
+    async def _connect_gcs(self) -> rpc.Connection:
+        path = self.gcs_addr.split(":", 1)[1]
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                reader, writer = await asyncio.open_unix_connection(path)
+                break
+            except (ConnectionRefusedError, FileNotFoundError):
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
+        conn = rpc.Connection(
+            reader, writer, rpc.handler_table(self), name="raylet->gcs"
+        )
+        conn.start()
+        return conn
+
+    # ------------- pubsub from GCS -------------
+    async def rpc_publish(self, conn, data):
+        channel, payload = data
+        if channel == "resources":
+            self.cluster_resources = payload
+        elif channel == "nodes":
+            self._on_nodes_update(payload)
+        return True
+
+    def _on_nodes_update(self, nodes: List[Dict]):
+        for n in nodes:
+            self.cluster_nodes[bytes(n["node_id"]).hex()] = n
+
+    async def _heartbeat_loop(self):
+        period = GLOBAL_CONFIG.health_check_period_ms / 1e3
+        while not self._stopping:
+            try:
+                await self.gcs.call_async(
+                    "heartbeat",
+                    [
+                        self.node_id,
+                        {"available": self.available, "total": self.total_resources},
+                    ],
+                    timeout=10,
+                )
+            except Exception:
+                if self._stopping:
+                    return
+            await asyncio.sleep(period)
+
+    # ------------- worker pool -------------
+    def _start_worker_process(self, tpu: bool = False) -> WorkerHandle:
+        from ray_tpu._private.node import clean_env
+
+        worker_id = WorkerID.from_random().binary()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "wb")
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.worker_main",
+            "--raylet", "unix:" + self.sock_path,
+            "--gcs", self.gcs_addr,
+            "--store", self.store_path,
+            "--node-id", self.node_id.hex(),
+            "--worker-id", worker_id.hex(),
+            "--session-dir", self.session_dir,
+        ]
+        env = clean_env(tpu=tpu)
+        env["RAYTPU_WORKER"] = "1"
+        proc = subprocess.Popen(
+            cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+        out.close()
+        w = WorkerHandle(worker_id, proc)
+        w.tpu = tpu
+        self.workers[worker_id] = w
+        return w
+
+    async def rpc_register_worker(self, conn, data):
+        """A spawned worker (or driver) announces itself."""
+        worker_id, addr, is_driver = data
+        if is_driver:
+            self.drivers[worker_id] = conn
+            conn.on_close = lambda c: self._on_driver_exit(worker_id)
+            return {"store_path": self.store_path, "node_id": self.node_id,
+                    "config": GLOBAL_CONFIG.dump()}
+        w = self.workers.get(worker_id)
+        if w is None:  # adopted worker (e.g. restarted raylet)
+            w = WorkerHandle(worker_id, None)
+            self.workers[worker_id] = w
+        w.conn = conn
+        w.addr = addr
+        conn.on_close = lambda c: asyncio.get_running_loop().create_task(
+            self._on_worker_exit(w)
+        )
+        w.registered.set()
+        self.idle.append(w)
+        self._pump_lease_queue()
+        return {"store_path": self.store_path, "node_id": self.node_id,
+                "config": GLOBAL_CONFIG.dump()}
+
+    def _on_driver_exit(self, worker_id: bytes):
+        self.drivers.pop(worker_id, None)
+
+    async def _on_worker_exit(self, w: WorkerHandle):
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle:
+            self.idle.remove(w)
+        if w.lease_id is not None and w.lease_id in self.leases:
+            lease = self.leases.pop(w.lease_id)
+            self._release_resources(lease.resources)
+        if w.actor_id is not None and not self._stopping:
+            try:
+                await self.gcs.call_async(
+                    "report_actor_death",
+                    [w.actor_id, "actor worker process died", False],
+                    timeout=10,
+                )
+            except Exception:
+                pass
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.terminate()
+        self._pump_lease_queue()
+
+    # ------------- resources -------------
+    def _can_fit(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(r, 0.0) >= q for r, q in resources.items())
+
+    def _feasible(self, resources: Dict[str, float]) -> bool:
+        return all(
+            self.total_resources.get(r, 0.0) >= q for r, q in resources.items()
+        )
+
+    def _acquire_resources(self, resources: Dict[str, float]):
+        for r, q in resources.items():
+            self.available[r] = self.available.get(r, 0.0) - q
+
+    def _release_resources(self, resources: Dict[str, float]):
+        for r, q in resources.items():
+            self.available[r] = min(
+                self.available.get(r, 0.0) + q,
+                self.total_resources.get(r, 0.0),
+            )
+
+    # ------------- lease protocol -------------
+    async def rpc_request_worker_lease(self, conn, summary: Dict):
+        """Grant a worker lease, queue, or spill to another node.
+
+        Reply: {"granted": .., "worker": Address wire, "lease_id": ..}
+           or  {"spillback": raylet_addr}
+           or  {"infeasible": True}
+        """
+        resources = summary.get("resources") or {}
+        if not self._feasible(resources):
+            target = self._pick_spillback(resources, strict=True)
+            if target:
+                return {"spillback": target}
+            return {"infeasible": True}
+        if not self._can_fit(resources):
+            target = self._pick_spillback(resources, strict=False)
+            if target:
+                return {"spillback": target}
+        fut = asyncio.get_running_loop().create_future()
+        self.lease_queue.append((summary, fut))
+        self._pump_lease_queue()
+        return await fut
+
+    def _pick_spillback(self, resources: Dict, strict: bool) -> Optional[str]:
+        """Pick another node with available (or feasible-total) capacity."""
+        me = self.node_id.hex()
+        for nid_hex, view in self.cluster_resources.items():
+            if nid_hex == me:
+                continue
+            pool = view.get("available" if not strict else "total", {})
+            if all(pool.get(r, 0.0) >= q for r, q in resources.items()):
+                node = self.cluster_nodes.get(nid_hex)
+                if node and node.get("alive", True):
+                    return node["raylet_addr"]
+        return None
+
+    def _pump_lease_queue(self):
+        if self._stopping:
+            return
+        remaining = []
+        for summary, fut in self.lease_queue:
+            if fut.done():
+                continue
+            resources = summary.get("resources") or {}
+            if not self._can_fit(resources):
+                remaining.append((summary, fut))
+                continue
+            tpu_needed = resources.get("TPU", 0) > 0
+            w = self._pop_idle_worker(tpu_needed)
+            if w is None:
+                remaining.append((summary, fut))
+                self._maybe_spawn_worker(tpu_needed)
+                continue
+            lease_id = os.urandom(16)
+            self._acquire_resources(resources)
+            w.lease_id = lease_id
+            self.leases[lease_id] = Lease(lease_id, w, resources)
+            fut.set_result(
+                {
+                    "granted": True,
+                    "worker": [w.worker_id, w.addr, self.node_id],
+                    "lease_id": lease_id,
+                }
+            )
+        self.lease_queue = remaining
+
+    def _pop_idle_worker(self, tpu: bool = False) -> Optional[WorkerHandle]:
+        for i in range(len(self.idle) - 1, -1, -1):
+            w = self.idle[i]
+            if not w.alive:
+                self.idle.pop(i)
+            elif w.tpu == tpu:
+                self.idle.pop(i)
+                return w
+        return None
+
+    def _maybe_spawn_worker(self, tpu: bool = False):
+        # one pending spawn per queued request, bounded by CPU slots
+        starting = sum(
+            1 for w in self.workers.values() if not w.registered.is_set()
+        )
+        busy = len(self.leases)
+        cap = max(int(self.total_resources.get("CPU", 1)), 1) + 2
+        if starting + busy + len(self.idle) < cap:
+            self._start_worker_process(tpu=tpu)
+
+    async def rpc_return_worker(self, conn, data):
+        lease_id, reusable = data
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        self._release_resources(lease.resources)
+        w = lease.worker
+        w.lease_id = None
+        if reusable and w.alive and w.actor_id is None:
+            self.idle.append(w)
+        elif w.proc is not None and w.proc.poll() is None:
+            w.proc.terminate()
+        self._pump_lease_queue()
+        return True
+
+    # ------------- actors -------------
+    async def rpc_create_actor(self, conn, spec: Dict):
+        """Called by the GCS: dedicate a worker and run the creation task."""
+        resources = spec.get("resources") or {}
+        if not self._feasible(resources):
+            return {"ok": False, "error": "infeasible on this node"}
+        fut = asyncio.get_running_loop().create_future()
+        self.lease_queue.append(({"resources": resources}, fut))
+        self._pump_lease_queue()
+        try:
+            grant = await asyncio.wait_for(fut, timeout=90)
+        except asyncio.TimeoutError:
+            return {"ok": False, "error": "no worker available"}
+        lease_id = grant["lease_id"]
+
+        def release(kill_worker: bool):
+            # Failed creation must not strand the lease (resources + worker).
+            lease = self.leases.pop(lease_id, None)
+            if lease is None:
+                return
+            self._release_resources(lease.resources)
+            lw = lease.worker
+            lw.lease_id = None
+            lw.actor_id = None
+            if kill_worker and lw.proc is not None and lw.proc.poll() is None:
+                lw.proc.terminate()
+            elif not kill_worker and lw.alive:
+                self.idle.append(lw)
+            self._pump_lease_queue()
+
+        w = self.workers.get(grant["worker"][0])
+        if w is None or not w.alive:
+            release(kill_worker=True)
+            return {"ok": False, "error": "worker died during creation"}
+        w.actor_id = spec["actor_id"]
+        try:
+            reply = await w.conn.call_async("create_actor_instance", spec,
+                                            timeout=300)
+        except Exception as e:
+            release(kill_worker=True)
+            return {"ok": False, "error": f"creation task failed: {e}"}
+        if not reply.get("ok"):
+            # user __init__ raised: deterministic failure, don't re-place
+            release(kill_worker=False)
+            return {"ok": False, "fatal": True,
+                    "error": reply.get("error", "creation failed")}
+        return {"ok": True, "address": [w.worker_id, w.addr, self.node_id]}
+
+    async def rpc_kill_worker(self, conn, data):
+        worker_id, _actor_id = data
+        w = self.workers.get(worker_id)
+        if w is None:
+            return False
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.kill()
+        return True
+
+    # ------------- object plane -------------
+    async def rpc_pull_object(self, conn, oid_bytes: bytes):
+        """Ensure the object is in the local store (fetch from a remote node).
+
+        Single-node: just report presence. Multi-node transfer lands with the
+        cluster milestone (chunked raylet-to-raylet pulls).
+        """
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(oid_bytes)
+        if self.store.contains(oid):
+            return True
+        locs = await self.gcs.call_async("get_object_locations", oid_bytes)
+        for node_id in locs:
+            nid_hex = bytes(node_id).hex()
+            if nid_hex == self.node_id.hex():
+                continue
+            node = self.cluster_nodes.get(nid_hex)
+            if node is None or not node.get("alive", True):
+                continue
+            ok = await self._fetch_from_node(oid, node["raylet_addr"])
+            if ok:
+                return True
+        return False
+
+    async def _fetch_from_node(self, oid, raylet_addr: str) -> bool:
+        """Chunked pull from a peer raylet into the local store."""
+        try:
+            path = raylet_addr.split(":", 1)[1]
+            reader, writer = await asyncio.open_unix_connection(path)
+            peer = rpc.Connection(reader, writer, rpc._null_handler,
+                                  name="raylet-pull")
+            peer.start()
+            try:
+                meta = await peer.call_async("read_object_meta", oid.binary(),
+                                             timeout=30)
+                if meta is None:
+                    return False
+                size = meta["size"]
+                chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
+                buf = self.store.create_buffer(oid, size)
+                try:
+                    for off in range(0, size, chunk):
+                        n = min(chunk, size - off)
+                        data = await peer.call_async(
+                            "read_object_chunk", [oid.binary(), off, n],
+                            timeout=60,
+                        )
+                        buf[off : off + n] = data
+                finally:
+                    del buf
+                self.store.seal(oid)
+                self.store.release(oid)
+                await self.gcs.call_async(
+                    "add_object_location", [oid.binary(), self.node_id]
+                )
+                return True
+            finally:
+                peer._do_close()
+        except Exception as e:
+            logger.warning("pull of %s from %s failed: %s",
+                           oid.hex()[:12], raylet_addr, e)
+            try:
+                self.store.abort(oid)
+            except Exception:
+                pass
+            return False
+
+    async def rpc_read_object_meta(self, conn, oid_bytes: bytes):
+        from ray_tpu._private.ids import ObjectID
+
+        view = self.store.get(ObjectID(oid_bytes), timeout=0)
+        if view is None:
+            return None
+        size = view.nbytes
+        view.release()
+        self.store.release(ObjectID(oid_bytes))
+        return {"size": size}
+
+    async def rpc_read_object_chunk(self, conn, data):
+        from ray_tpu._private.ids import ObjectID
+
+        oid_bytes, off, n = data
+        oid = ObjectID(oid_bytes)
+        view = self.store.get(oid, timeout=0)
+        if view is None:
+            return None
+        try:
+            return bytes(view[off : off + n])
+        finally:
+            view.release()
+            self.store.release(oid)
+
+    # ------------- introspection -------------
+    async def rpc_node_stats(self, conn, _):
+        return {
+            "node_id": self.node_id.hex(),
+            "available": self.available,
+            "total": self.total_resources,
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle),
+            "num_leases": len(self.leases),
+            "queue_len": len(self.lease_queue),
+            "store": self.store.stats() if self.store else {},
+        }
+
+    async def rpc_ping(self, conn, _):
+        return "pong"
+
+
+def main():
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--sock")
+    p.add_argument("--store")
+    p.add_argument("--gcs")
+    p.add_argument("--node-id")
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--session-dir")
+    p.add_argument("--config", default="")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[raylet %(asctime)s] %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    if args.config:
+        GLOBAL_CONFIG.load(json.loads(args.config))
+
+    async def run():
+        raylet = Raylet(
+            node_id=bytes.fromhex(args.node_id),
+            sock_path=args.sock,
+            store_path=args.store,
+            gcs_addr=args.gcs,
+            resources=json.loads(args.resources),
+            session_dir=args.session_dir,
+            labels=json.loads(args.labels),
+        )
+        await raylet.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,7 @@ class Connection:
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
-        self.on_close: Optional[Callable[["Connection"], None]] = None
+        self._close_callbacks: list = []
         self._read_task: Optional[asyncio.Task] = None
         self._write_lock = asyncio.Lock()
 
@@ -154,6 +154,8 @@ class Connection:
     async def _send(self, kind, seqno, method, data):
         body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
         async with self._write_lock:
+            if self._closed or self.writer.is_closing():
+                raise ConnectionError(f"connection {self.name} closed")
             self.writer.write(len(body).to_bytes(4, "big"))
             self.writer.write(body)
             await self.writer.drain()
@@ -163,7 +165,10 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
         try:
-            await self._send(_REQUEST, seqno, method, data)
+            try:
+                await self._send(_REQUEST, seqno, method, data)
+            except Exception as e:
+                raise SendError(str(e)) from e
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
@@ -172,6 +177,22 @@ class Connection:
 
     async def notify_async(self, method: str, data: Any):
         await self._send(_NOTIFY, None, method, data)
+
+    def add_close_callback(self, cb: Callable[["Connection"], None]):
+        if self._closed:
+            cb(self)
+        else:
+            self._close_callbacks.append(cb)
+
+    # Back-compat single-slot setter: appends rather than replacing.
+    @property
+    def on_close(self):
+        return self._close_callbacks[-1] if self._close_callbacks else None
+
+    @on_close.setter
+    def on_close(self, cb):
+        if cb is not None:
+            self.add_close_callback(cb)
 
     def _do_close(self):
         if self._closed:
@@ -185,9 +206,12 @@ class Connection:
             self.writer.close()
         except Exception:
             pass
-        if self.on_close:
-            cb, self.on_close = self.on_close, None
-            cb(self)
+        cbs, self._close_callbacks = self._close_callbacks, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
     @property
     def closed(self):
@@ -199,6 +223,10 @@ class Connection:
 
 class RpcError(Exception):
     pass
+
+
+class SendError(ConnectionError):
+    """The request was never written to the socket (safe to retry)."""
 
 
 _global_stats = MethodStats()
@@ -221,7 +249,9 @@ class Server:
     async def _on_client(self, reader, writer):
         conn = Connection(reader, writer, self.handler, name=self.name)
         self.connections.append(conn)
-        conn.on_close = lambda c: self.connections.remove(c) if c in self.connections else None
+        conn.add_close_callback(
+            lambda c: self.connections.remove(c) if c in self.connections else None
+        )
         conn.start()
 
     async def start_async(self):

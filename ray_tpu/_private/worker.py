@@ -1,0 +1,134 @@
+"""Global driver/worker state + connect/disconnect.
+
+Parity: reference ``python/ray/_private/worker.py`` — the module-level
+``global_worker`` (:410), ``init`` (:1108), ``connect`` (:2049).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+from typing import Dict, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.core_worker import MODE_DRIVER, CoreWorker
+from ray_tpu._private.ids import JobID, NodeID, WorkerID
+from ray_tpu._private.node import Cluster
+
+logger = logging.getLogger(__name__)
+
+
+class Worker:
+    def __init__(self):
+        self.core_worker: Optional[CoreWorker] = None
+        self.mode: Optional[str] = None
+        self.connected = False
+        self.cluster: Optional[Cluster] = None  # owned if we started it
+        self.job_id: bytes = b"\x00" * 16
+
+
+global_worker = Worker()
+
+
+def init(
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    system_config: Optional[Dict] = None,
+    _node_defaults: bool = True,
+) -> Dict:
+    """Start a local cluster (GCS + raylet) and connect this process as driver."""
+    if global_worker.connected:
+        logger.warning("ray_tpu.init() called twice; ignoring")
+        return {}
+    GLOBAL_CONFIG.initialize(system_config)
+    if object_store_memory:
+        GLOBAL_CONFIG.load({"object_store_memory_bytes": int(object_store_memory)})
+
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    elif _node_defaults:
+        res.setdefault("CPU", float(os.cpu_count() or 4))
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    elif _node_defaults and "TPU" not in res:
+        n = _detect_tpu_chips()
+        if n:
+            res["TPU"] = float(n)
+
+    cluster = Cluster()
+    cluster.start_gcs(system_config)
+    cluster.add_node(resources=res, head=True)
+    global_worker.cluster = cluster
+    connect(
+        raylet_addr=cluster.head_node.raylet_addr,
+        gcs_addr=cluster.gcs_addr,
+        store_path=cluster.head_node.store_path,
+        node_id=cluster.head_node.node_id,
+        session_dir=cluster.session_dir,
+    )
+    atexit.register(shutdown)
+    return {
+        "session_dir": cluster.session_dir,
+        "gcs_address": cluster.gcs_addr,
+        "node_id": cluster.head_node.node_id.hex(),
+    }
+
+
+def _detect_tpu_chips() -> int:
+    try:
+        import jax
+
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+    except Exception:
+        return 0
+
+
+def connect(*, raylet_addr, gcs_addr, store_path, node_id, session_dir):
+    job_id = JobID.from_random().binary()
+    cw = CoreWorker(
+        mode=MODE_DRIVER,
+        worker_id=WorkerID.from_random().binary(),
+        node_id=node_id,
+        raylet_addr=raylet_addr,
+        gcs_addr=gcs_addr,
+        store_path=store_path,
+        session_dir=session_dir,
+        job_id=job_id,
+    )
+    cw.gcs.call("register_job", [job_id, {"driver_pid": os.getpid()}])
+    global_worker.core_worker = cw
+    global_worker.mode = MODE_DRIVER
+    global_worker.connected = True
+    global_worker.job_id = job_id
+    return cw
+
+
+def shutdown():
+    if not global_worker.connected:
+        return
+    try:
+        global_worker.core_worker.shutdown()
+    except Exception:
+        pass
+    if global_worker.cluster is not None:
+        global_worker.cluster.shutdown()
+    global_worker.core_worker = None
+    global_worker.cluster = None
+    global_worker.connected = False
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def require_connected() -> CoreWorker:
+    if not global_worker.connected:
+        raise RuntimeError(
+            "ray_tpu.init() must be called before using the API"
+        )
+    return global_worker.core_worker

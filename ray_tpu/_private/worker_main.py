@@ -1,0 +1,57 @@
+"""Worker process entry point.
+
+Parity: reference ``python/ray/_private/workers/default_worker.py`` — launched
+by the raylet's worker pool (worker_pool.cc:426); registers, then runs the
+task execution loop on the main thread (JAX device runtime lives there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--raylet")
+    p.add_argument("--gcs")
+    p.add_argument("--store")
+    p.add_argument("--node-id")
+    p.add_argument("--worker-id")
+    p.add_argument("--session-dir")
+    p.add_argument("--job-id", default="00" * 16)
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[worker %(asctime)s] %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+
+    from ray_tpu._private.core_worker import MODE_WORKER, CoreWorker
+    from ray_tpu._private import worker as worker_mod
+
+    cw = CoreWorker(
+        mode=MODE_WORKER,
+        worker_id=bytes.fromhex(args.worker_id),
+        node_id=bytes.fromhex(args.node_id),
+        raylet_addr=args.raylet,
+        gcs_addr=args.gcs,
+        store_path=args.store,
+        session_dir=args.session_dir,
+        job_id=bytes.fromhex(args.job_id),
+    )
+    worker_mod.global_worker.core_worker = cw
+    worker_mod.global_worker.mode = MODE_WORKER
+    worker_mod.global_worker.connected = True
+    try:
+        cw.execution_loop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cw.shutdown()
+
+
+if __name__ == "__main__":
+    main()

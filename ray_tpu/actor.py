@@ -1,0 +1,109 @@
+"""Actor API: @ray_tpu.remote classes -> ActorClass / ActorHandle / ActorMethod.
+
+Parity: reference ``python/ray/actor.py`` (ActorClass:383, _remote:665,
+ActorHandle:1024, ActorMethod:98).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.core_worker import _KwArgs
+from ray_tpu._private.worker import require_connected
+from ray_tpu.remote_function import _normalize_opts, _resources_from
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Actor method {self._name!r} cannot be called directly; "
+            f"use .remote()."
+        )
+
+    def options(self, num_returns: Optional[int] = None):
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+        )
+
+    def remote(self, *args, **kwargs):
+        cw = require_connected()
+        values = list(args)
+        if kwargs:
+            values.append(_KwArgs(kwargs))
+        wire, pinned = cw._encode_args(values)
+        refs = cw.submit_actor_task(
+            self._handle._actor_id,
+            self._name,
+            wire,
+            num_returns=self._num_returns,
+            pinned=pinned,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def _actor_hex(self):
+        return self._actor_id.hex()
+
+
+class ActorClass:
+    def __init__(self, cls, **default_opts):
+        self._cls = cls
+        self._opts = _normalize_opts(default_opts)
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly. Use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._opts)
+        merged.update(_normalize_opts(opts))
+        ac = ActorClass(self._cls)
+        ac._opts = merged
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = require_connected()
+        values = list(args)
+        if kwargs:
+            values.append(_KwArgs(kwargs))
+        wire, pinned = cw._encode_args(values)
+        opts = self._opts
+        actor_id = cw.create_actor(
+            self._cls,
+            wire,
+            name=self._cls.__name__,
+            actor_name=opts.get("name") or "",
+            resources=_resources_from(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            pinned=pinned,
+        )
+        return ActorHandle(actor_id, self._cls.__name__)

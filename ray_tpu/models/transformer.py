@@ -59,7 +59,7 @@ class TransformerConfig:
             self.kv_heads,
             self.d_head,
         )
-        per_layer = d * dh * (h + 2 * kv) + h * dh * d + 2 * d * f + 2 * d
+        per_layer = d * dh * (h + 2 * kv) + h * dh * d + 2 * d * f + d
         head = 0 if self.tie_embeddings else d * self.vocab_size
         return self.vocab_size * d + self.n_layers * per_layer + d + head
 
@@ -210,7 +210,7 @@ def forward(
 
         attn_fn = partial(ring_attention, mesh=mesh)
     elif c.attn_impl == "flash":
-        from ray_tpu.ops.pallas.flash_attention import flash_attention
+        from ray_tpu.ops.flash_attention import flash_attention
 
         attn_fn = flash_attention
     else:

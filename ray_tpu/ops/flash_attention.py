@@ -1,0 +1,11 @@
+"""Pallas flash-attention kernel (TPU). Placeholder until the kernel lands:
+falls back to the XLA-fused dense path so `attn_impl='flash'` is usable.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.ops.attention import causal_attention
+
+
+def flash_attention(q, k, v):
+    return causal_attention(q, k, v)

@@ -1,0 +1,89 @@
+"""@ray_tpu.remote functions.
+
+Parity: reference ``python/ray/remote_function.py`` (RemoteFunction:39,
+_remote:245) — decorator machinery, ``.options()`` overrides.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.core_worker import _KwArgs
+from ray_tpu._private.worker import require_connected
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_opts):
+        self._fn = fn
+        self._opts = _normalize_opts(default_opts)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called directly. "
+            f"Use {self._fn.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(_normalize_opts(opts))
+        rf = RemoteFunction(self._fn)
+        rf._opts = merged
+        return rf
+
+    def remote(self, *args, **kwargs):
+        cw = require_connected()
+        values = list(args)
+        if kwargs:
+            values.append(_KwArgs(kwargs))
+        wire, pinned = cw._encode_args(values)
+        opts = self._opts
+        refs = cw.submit_task(
+            self._fn,
+            wire,
+            name=opts.get("name") or self._fn.__name__,
+            num_returns=opts.get("num_returns", 1),
+            resources=_resources_from(opts),
+            max_retries=opts.get("max_retries"),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            scheduling_strategy=_encode_strategy(opts.get("scheduling_strategy")),
+            pinned=pinned,
+        )
+        if opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+
+def _normalize_opts(opts: Dict[str, Any]) -> Dict[str, Any]:
+    known = {
+        "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
+        "retry_exceptions", "name", "scheduling_strategy", "max_restarts",
+        "max_concurrency", "runtime_env", "num_gpus", "memory", "lifetime",
+    }
+    for k in opts:
+        if k not in known:
+            raise ValueError(f"unknown option {k!r}")
+    return dict(opts)
+
+
+def _resources_from(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if "num_cpus" in opts and opts["num_cpus"] is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    else:
+        res.setdefault("CPU", 1.0)
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    if res.get("CPU") == 0:
+        res.pop("CPU")
+    return res
+
+
+def _encode_strategy(strategy):
+    if strategy is None or isinstance(strategy, str):
+        return strategy
+    to_wire = getattr(strategy, "to_wire", None)
+    return to_wire() if to_wire else None

@@ -1,0 +1,191 @@
+"""Actor tests — parity with the reference's python/ray/tests/test_actor.py
+and test_actor_failures.py surfaces."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def fail(self):
+        raise ValueError("actor method failure")
+
+
+def test_actor_basic(rt):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_init_args(rt):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+    c2 = Counter.remote(start=7)
+    assert ray_tpu.get(c2.value.remote()) == 7
+
+
+def test_actor_state_isolated(rt):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(a.value.remote()) == 1
+    assert ray_tpu.get(b.value.remote()) == 0
+
+
+def test_actors_run_in_separate_processes(rt):
+    a, b = Counter.remote(), Counter.remote()
+    pa, pb = ray_tpu.get([a.pid.remote(), b.pid.remote()])
+    assert pa != pb
+    assert pa != os.getpid()
+
+
+def test_actor_method_error(rt):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError) as ei:
+        ray_tpu.get(c.fail.remote())
+    assert "actor method failure" in str(ei.value)
+    # actor still alive after a method error
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+
+def test_actor_ordering(rt):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_named_actor(rt):
+    Counter.options(name="global_counter").remote(5)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.value.remote()) == 5
+
+
+def test_named_actor_duplicate_rejected(rt):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(rt):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_kill_actor(rt):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(
+        (ray_tpu.exceptions.ActorError, ray_tpu.exceptions.TaskError)
+    ):
+        ray_tpu.get(c.incr.remote(), timeout=10)
+
+
+def test_actor_creation_error(rt):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def f(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(
+        (ray_tpu.exceptions.ActorError, ray_tpu.exceptions.TaskError)
+    ):
+        ray_tpu.get(b.f.remote(), timeout=30)
+
+
+def test_actor_restart(rt):
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    pid1 = ray_tpu.get(p.pid.remote())
+    try:
+        ray_tpu.get(p.die.remote(), timeout=10)
+    except Exception:
+        pass
+    # restarted actor: fresh state, new process
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            n = ray_tpu.get(p.incr.remote(), timeout=10)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    assert n == 1
+    assert ray_tpu.get(p.pid.remote()) != pid1
+
+
+def test_pass_actor_handle_to_task(rt):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.value.remote()) == 1
+
+
+def test_actor_calls_tasks(rt):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    class Orchestrator:
+        def run(self, x):
+            return ray_tpu.get(double.remote(x))
+
+    o = Orchestrator.remote()
+    assert ray_tpu.get(o.run.remote(21)) == 42
+
+
+def test_actor_ordering_with_pending_dependency(rt):
+    """A later no-dep call must not overtake an earlier call stuck resolving
+    its dependency (per-caller FIFO, reference actor queue semantics)."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(1)
+        return 5
+
+    c = Counter.remote()
+    c.incr.remote(slow_value.remote())
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 5

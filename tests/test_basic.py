@@ -1,0 +1,160 @@
+"""Core API tests: tasks, objects, errors — parity with the reference's
+python/ray/tests/test_basic.py surface."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(rt):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_array_zero_copy(rt):
+    arr = np.arange(1 << 20, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    assert not out.flags["OWNDATA"]  # zero-copy view over the store
+
+
+def test_simple_task(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_kwargs_and_options(rt):
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a * b
+
+    assert ray_tpu.get(f.remote(3)) == 30
+    assert ray_tpu.get(f.remote(3, b=2)) == 6
+    assert ray_tpu.get(f.options(name="custom").remote(2)) == 20
+
+
+def test_many_tasks(rt):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_arg_by_ref(rt):
+    @ray_tpu.remote
+    def plus1(x):
+        return x + 1
+
+    r1 = plus1.remote(1)
+    r2 = plus1.remote(r1)
+    r3 = plus1.remote(r2)
+    assert ray_tpu.get(r3) == 4
+
+
+def test_large_arg_through_plasma(rt):
+    arr = np.ones(1 << 20, dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == float(arr.sum())
+
+
+def test_large_return_through_plasma(rt):
+    @ray_tpu.remote
+    def make():
+        return np.full(1 << 20, 7, dtype=np.int32)
+
+    out = ray_tpu.get(make.remote())
+    assert out.shape == (1 << 20,)
+    assert int(out[123]) == 7
+
+
+def test_task_error_reraised(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("deliberate")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "deliberate" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(rt):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(5)) == 11
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert pending == [s]
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_cluster_resources(rt):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4
+
+
+def test_is_initialized(rt):
+    assert ray_tpu.is_initialized()
