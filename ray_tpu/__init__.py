@@ -24,13 +24,14 @@ from ray_tpu._private.worker import (  # noqa: F401
 )
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 
 __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "ObjectRef", "available_resources",
-    "cluster_resources", "nodes", "exceptions", "method",
+    "cluster_resources", "nodes", "exceptions", "method", "get_runtime_context",
 ]
 
 

@@ -152,6 +152,9 @@ class CoreWorker:
         # task manager (owner side)
         self._pending_tasks: Dict[bytes, Dict] = {}
         self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._lineage_pinned: Dict[bytes, List] = {}  # task_id -> arg refs
+        self._pull_failures: Dict[ObjectID, int] = collections.defaultdict(int)
+        self._recovering: set = set()
 
         # lease/submit machinery (on IO loop)
         self._lease_states: Dict[Tuple, _LeaseState] = {}
@@ -256,7 +259,7 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[int, Any] = {}
         remaining = {i: r for i, r in enumerate(refs)}
-        requested_pull: set = set()
+        requested_pull: Dict[ObjectID, float] = {}
         while remaining:
             for i, ref in list(remaining.items()):
                 val = self._try_get_one(ref, requested_pull)
@@ -304,8 +307,23 @@ class CoreWorker:
             if isinstance(value, exc.ErrorObject):
                 return _Err(value.error)
             return value
-        if ref.id not in requested_pull:
-            requested_pull.add(ref.id)
+        failures = self._pull_failures.get(ref.id, 0)
+        if failures > 0:
+            if self._maybe_recover(ref):
+                self._pull_failures.pop(ref.id, None)
+            elif failures >= 3:
+                self._pull_failures.pop(ref.id, None)
+                return _Err(exc.ObjectLostError(
+                    object_ref_hex=ref.hex(),
+                    reason="all copies lost and no lineage to reconstruct",
+                ))
+        # Time-based re-request: pulls are idempotent, and one-shot request
+        # tracking can stall if a failure is cleared while no pull is in
+        # flight (e.g. right as a reconstruction completes).
+        now = time.monotonic()
+        last = requested_pull.get(ref.id, 0.0) if isinstance(requested_pull, dict) else 0.0
+        if now - last > 0.2:
+            requested_pull[ref.id] = now
             self.io.submit(self._pull_async(ref))
         return _NOT_READY
 
@@ -315,6 +333,7 @@ class CoreWorker:
                 "pull_object", ref.binary(), timeout=60
             )
             if ok:
+                self._pull_failures.pop(ref.id, None)
                 return
             # Fall back to asking the owner directly (memory-store values).
             owner = ref.owner_address
@@ -327,8 +346,33 @@ class CoreWorker:
                         self.memory_store.put_error(ref.id, value.error)
                     else:
                         self.memory_store.put_value(ref.id, value)
+                    self._pull_failures.pop(ref.id, None)
+                    return
+            self._pull_failures[ref.id] += 1
         except Exception as e:
             logger.debug("pull failed for %s: %s", ref.hex()[:12], e)
+            self._pull_failures[ref.id] += 1
+
+    # ---- lineage reconstruction (parity: reference ObjectRecoveryManager
+    # object_recovery_manager.h:41 + TaskManager::ResubmitTask task_manager.h:234;
+    # here the owner resubmits the creating task when every copy is lost) ----
+    def _maybe_recover(self, ref: ObjectRef) -> bool:
+        if not GLOBAL_CONFIG.lineage_pinning_enabled:
+            return False
+        spec = self._lineage.get(ref.id)
+        if spec is None:
+            return False
+        if spec.task_id in self._recovering:
+            return True  # already resubmitted, keep waiting
+        self._recovering.add(spec.task_id)
+        logger.info("reconstructing %s via task %s", ref.hex()[:12], spec.name)
+        self._pending_tasks[spec.task_id] = {
+            "spec": spec,
+            "retries_left": max(spec.max_retries, 1),
+            "pinned": self._lineage_pinned.get(spec.task_id, []),
+        }
+        self.io.submit(self._submit_async(spec))
+        return True
 
     async def rpc_get_object(self, conn, oid_bytes: bytes):
         """Serve an owned object's value to a borrower."""
@@ -353,20 +397,26 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
-        requested: set = set()
+        requested: Dict[ObjectID, float] = {}
         while True:
             still = []
             for ref in pending:
                 e = self.memory_store.get(ref.id)
-                done = (
-                    (e is not None and e.event.is_set() and e.kind != "plasma")
-                    or self.store.contains(ref.id)
-                )
-                if not done and e is not None and e.event.is_set() and e.kind == "plasma":
-                    done = self.store.contains(ref.id)
-                    if not done and fetch_local and ref.id not in requested:
-                        requested.add(ref.id)
-                        self.io.submit(self._pull_async(ref))
+                resolved = e is not None and e.event.is_set()
+                local = self.store.contains(ref.id)
+                if resolved and e.kind == "plasma" and not local:
+                    # Object exists remotely: that's "ready" per reference
+                    # semantics; fetch_local additionally pulls the value.
+                    if fetch_local:
+                        now = time.monotonic()
+                        if now - requested.get(ref.id, 0.0) > 0.2:
+                            requested[ref.id] = now
+                            self.io.submit(self._pull_async(ref))
+                        done = False  # wait for the local copy
+                    else:
+                        done = True
+                else:
+                    done = resolved or local
                 if done:
                     ready.append(ref)
                 else:
@@ -619,10 +669,15 @@ class CoreWorker:
                     self.memory_store.put_value(oid, value)
             elif kind == "p":
                 self.memory_store.put_plasma(oid, [worker_addr[2]])
-        self._pending_tasks.pop(spec.task_id, None)
+        info = self._pending_tasks.pop(spec.task_id, None)
+        self._recovering.discard(spec.task_id)
         if GLOBAL_CONFIG.lineage_pinning_enabled:
             for r in spec.return_ids():
                 self._lineage[r] = spec
+                self._pull_failures.pop(r, None)
+            if info and info.get("pinned"):
+                # Lineage keeps arg objects resurrectable for resubmission.
+                self._lineage_pinned[spec.task_id] = info["pinned"]
 
     def _handle_worker_failure(self, spec: TaskSpec, error: BaseException):
         info = self._pending_tasks.get(spec.task_id)

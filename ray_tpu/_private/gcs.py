@@ -186,6 +186,16 @@ class GcsServer:
         self.node_resources.pop(node_id, None)
         self._publish("nodes", [info.to_wire()])
         self._publish("resources", self._resource_view())
+        # Purge the dead node from the object directory so pulls don't chase
+        # vanished copies (owners then trigger lineage reconstruction).
+        for key in [k for k in self.kv if k.startswith("loc:")]:
+            locs = [bytes(l) for l in rpc.msgpack.unpackb(self.kv[key])]
+            if node_id in locs:
+                locs = [l for l in locs if l != node_id]
+                if locs:
+                    self.kv[key] = rpc.msgpack.packb(locs)
+                else:
+                    self.kv.pop(key, None)
         # Actors on that node die (and maybe restart elsewhere).
         for rec in list(self.actors.values()):
             if rec.address and rec.address[2] == node_id and rec.state in (

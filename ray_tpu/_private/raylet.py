@@ -261,6 +261,19 @@ class Raylet:
     def _can_fit(self, resources: Dict[str, float]) -> bool:
         return all(self.available.get(r, 0.0) >= q for r, q in resources.items())
 
+    def _can_fit_with_queue(self, resources: Dict[str, float]) -> bool:
+        """Would this request fit after already-queued demand is served?"""
+        queued: Dict[str, float] = {}
+        for summary, fut in self.lease_queue:
+            if fut.done():
+                continue
+            for r, q in (summary.get("resources") or {}).items():
+                queued[r] = queued.get(r, 0.0) + q
+        return all(
+            self.available.get(r, 0.0) - queued.get(r, 0.0) >= q
+            for r, q in resources.items()
+        )
+
     def _feasible(self, resources: Dict[str, float]) -> bool:
         return all(
             self.total_resources.get(r, 0.0) >= q for r, q in resources.items()
@@ -291,7 +304,10 @@ class Raylet:
             if target:
                 return {"spillback": target}
             return {"infeasible": True}
-        if not self._can_fit(resources):
+        if not self._can_fit_with_queue(resources):
+            # Local node is (or will be, counting queued demand) saturated:
+            # prefer an idle peer (hybrid pack-then-spread policy, parity:
+            # reference hybrid_scheduling_policy.h:50).
             target = self._pick_spillback(resources, strict=False)
             if target:
                 return {"spillback": target}
