@@ -146,8 +146,7 @@ def available_resources() -> Dict[str, float]:
         if not n.get("alive", True):
             continue
         try:
-            path = n["raylet_addr"].split(":", 1)[1]
-            client = rpc_mod.Client.connect(path, timeout=5)
+            client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
             stats = client.call("node_stats", None, timeout=5)
             client.close()
             for k, v in stats.get("available", {}).items():

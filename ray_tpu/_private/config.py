@@ -91,6 +91,7 @@ _d("max_lineage_bytes", int, 1024**3)
 _d("prestart_workers", bool, True)
 _d("worker_pool_min_idle", int, 0)
 _d("scheduler_spread_threshold", float, 0.5)
+_d("infeasible_task_grace_s", float, 30.0)
 _d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
 _d("memory_monitor_refresh_ms", int, 250)
 _d("memory_usage_threshold", float, 0.95)

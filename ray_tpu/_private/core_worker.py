@@ -121,22 +121,30 @@ class CoreWorker:
         self.store = SharedMemoryStore.attach(store_path)
         self.memory_store = MemoryStore()
 
-        sock_dir = os.path.join(session_dir, "sockets")
-        os.makedirs(sock_dir, exist_ok=True)
-        self.my_sock = os.path.join(sock_dir, f"w-{worker_id.hex()[:16]}.sock")
-        self.my_addr = "unix:" + self.my_sock
-        self.address = Address(worker_id, self.my_addr, node_id)
-
+        # Serve where our raylet serves: unix for same-host clusters, TCP when
+        # the node is network-addressable (workers are peers in cross-host
+        # actor/task pushes — parity: reference core worker gRPC server).
+        if raylet_addr.startswith("tcp:"):
+            host = rpc.parse_addr(raylet_addr)[1].rsplit(":", 1)[0]
+            serve_addr = f"tcp:{host}:0"
+        else:
+            sock_dir = os.path.join(session_dir, "sockets")
+            os.makedirs(sock_dir, exist_ok=True)
+            serve_addr = "unix:" + os.path.join(
+                sock_dir, f"w-{worker_id.hex()[:16]}.sock"
+            )
         self.server = rpc.Server(
-            self.my_sock, rpc.handler_table(self), name=f"worker-{worker_id.hex()[:8]}"
+            serve_addr, rpc.handler_table(self), name=f"worker-{worker_id.hex()[:8]}"
         )
         self.io.run(self.server.start_async())
+        self.my_addr = self.server.addr
+        self.address = Address(worker_id, self.my_addr, node_id)
 
         self.gcs = rpc.Client.connect(
-            gcs_addr.split(":", 1)[1], handler=rpc.handler_table(self), name="->gcs"
+            gcs_addr, handler=rpc.handler_table(self), name="->gcs"
         )
         self.raylet = rpc.Client.connect(
-            raylet_addr.split(":", 1)[1],
+            raylet_addr,
             handler=rpc.handler_table(self),
             name="->raylet",
         )
@@ -704,8 +712,7 @@ class CoreWorker:
         conn = self._worker_conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
-        path = addr.split(":", 1)[1]
-        reader, writer = await asyncio.open_unix_connection(path)
+        reader, writer = await rpc.open_connection(addr)
         conn = rpc.Connection(
             reader, writer, rpc.handler_table(self), name=f"->{addr[-20:]}"
         )

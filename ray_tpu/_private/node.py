@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import tempfile
@@ -23,6 +24,27 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import NodeID
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """Kernel-assigned free TCP port (tiny race window; fine for bootstrap)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def node_ip_address() -> str:
+    """This host's primary outbound IP (parity: services.get_node_ip_address)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent for UDP connect
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 def new_session_dir() -> str:
@@ -65,32 +87,38 @@ def _spawn(cmd, log_path) -> subprocess.Popen:
     return proc
 
 
-def _wait_sock(path: str, timeout=30.0, proc: Optional[subprocess.Popen] = None):
+def _wait_addr(addr: str, timeout=30.0, proc: Optional[subprocess.Popen] = None):
+    """Wait until a daemon serves at `addr` (unix: path exists; tcp: connects)."""
+    scheme, rest = rpc.parse_addr(addr)
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        if os.path.exists(path):
-            return
+        if scheme == "unix":
+            if os.path.exists(rest):
+                return
+        else:
+            host, port = rest.rsplit(":", 1)
+            try:
+                socket.create_connection((host, int(port)), timeout=1).close()
+                return
+            except OSError:
+                pass
         if proc is not None and proc.poll() is not None:
             raise RuntimeError(
-                f"process exited with {proc.returncode} before serving {path}"
+                f"process exited with {proc.returncode} before serving {addr}"
             )
         time.sleep(0.02)
-    raise TimeoutError(f"timed out waiting for {path}")
+    raise TimeoutError(f"timed out waiting for {addr}")
 
 
 class NodeProcs:
     """One raylet (+store) on this host."""
 
     def __init__(self, node_id: bytes, proc: subprocess.Popen,
-                 raylet_sock: str, store_path: str):
+                 raylet_addr: str, store_path: str):
         self.node_id = node_id
         self.proc = proc
-        self.raylet_sock = raylet_sock
+        self.raylet_addr = raylet_addr
         self.store_path = store_path
-
-    @property
-    def raylet_addr(self):
-        return "unix:" + self.raylet_sock
 
     def kill(self):
         if self.proc.poll() is None:
@@ -106,29 +134,56 @@ class Cluster:
     """Head processes: GCS + head raylet; `add_node` fakes extra nodes.
 
     Parity: reference python/ray/cluster_utils.py Cluster:99/add_node:165.
+
+    ``use_tcp=True`` runs every control-plane endpoint over TCP (the DCN
+    path of a real multi-host deployment); ``gcs_address`` joins an existing
+    remote GCS instead of starting one (parity: ray start --address).
     """
 
-    def __init__(self, session_dir: Optional[str] = None):
+    def __init__(
+        self,
+        session_dir: Optional[str] = None,
+        use_tcp: bool = False,
+        node_ip: Optional[str] = None,
+        gcs_address: Optional[str] = None,
+    ):
         self.session_dir = session_dir or new_session_dir()
+        self.use_tcp = use_tcp or (
+            gcs_address is not None and gcs_address.startswith("tcp:")
+        )
+        if node_ip is None:
+            # Joining a remote head: register a cross-host-reachable IP.
+            # Local (single-host) TCP clusters stay on loopback.
+            node_ip = (
+                node_ip_address() if gcs_address is not None else "127.0.0.1"
+            )
+        self.node_ip = node_ip
         self.gcs_sock = os.path.join(self.session_dir, "sockets", "gcs.sock")
+        self._gcs_addr: Optional[str] = gcs_address
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.nodes: Dict[bytes, NodeProcs] = {}
         self.head_node: Optional[NodeProcs] = None
 
     @property
     def gcs_addr(self):
+        if self._gcs_addr is not None:
+            return self._gcs_addr
         return "unix:" + self.gcs_sock
 
     def start_gcs(self, system_config: Optional[Dict] = None):
+        if self._gcs_addr is not None:
+            raise RuntimeError("joined an external GCS; not starting one")
+        if self.use_tcp:
+            self._gcs_addr = f"tcp:{self.node_ip}:{pick_free_port(self.node_ip)}"
         cfg = json.dumps(GLOBAL_CONFIG.dump()) if system_config is None else (
             json.dumps({**GLOBAL_CONFIG.dump(), **system_config})
         )
         self.gcs_proc = _spawn(
             [sys.executable, "-m", "ray_tpu._private.gcs",
-             "--sock", self.gcs_sock, "--config", cfg],
+             "--sock", self.gcs_addr, "--config", cfg],
             os.path.join(self.session_dir, "logs", "gcs.log"),
         )
-        _wait_sock(self.gcs_sock, proc=self.gcs_proc)
+        _wait_addr(self.gcs_addr, proc=self.gcs_proc)
 
     def add_node(
         self,
@@ -139,7 +194,12 @@ class Cluster:
     ) -> NodeProcs:
         node_id = NodeID.from_random().binary()
         hexid = node_id.hex()[:12]
-        raylet_sock = os.path.join(self.session_dir, "sockets", f"raylet-{hexid}.sock")
+        if self.use_tcp:
+            raylet_addr = f"tcp:{self.node_ip}:{pick_free_port(self.node_ip)}"
+        else:
+            raylet_addr = "unix:" + os.path.join(
+                self.session_dir, "sockets", f"raylet-{hexid}.sock"
+            )
         store_path = os.path.join(_SHM_DIR, f"raytpu_{os.getpid()}_{hexid}")
         resources = dict(resources or {})
         resources.setdefault("CPU", float(os.cpu_count() or 4))
@@ -148,7 +208,7 @@ class Cluster:
             cfg["object_store_memory_bytes"] = int(object_store_memory)
         proc = _spawn(
             [sys.executable, "-m", "ray_tpu._private.raylet",
-             "--sock", raylet_sock,
+             "--sock", raylet_addr,
              "--store", store_path,
              "--gcs", self.gcs_addr,
              "--node-id", node_id.hex(),
@@ -158,8 +218,8 @@ class Cluster:
              "--config", json.dumps(cfg)],
             os.path.join(self.session_dir, "logs", f"raylet-{hexid}.log"),
         )
-        _wait_sock(raylet_sock, proc=proc)
-        node = NodeProcs(node_id, proc, raylet_sock, store_path)
+        _wait_addr(raylet_addr, proc=proc)
+        node = NodeProcs(node_id, proc, raylet_addr, store_path)
         self.nodes[node_id] = node
         if head:
             self.head_node = node

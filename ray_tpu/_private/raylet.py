@@ -62,7 +62,7 @@ class Raylet:
     def __init__(
         self,
         node_id: bytes,
-        sock_path: str,
+        sock_path: str,  # scheme address (unix:<path> or tcp:<host>:<port>)
         store_path: str,
         gcs_addr: str,
         resources: Dict[str, float],
@@ -87,6 +87,10 @@ class Raylet:
         self.drivers: Dict[bytes, rpc.Connection] = {}
         # lease queue: (spec_summary, future)
         self.lease_queue: List[Tuple[Dict, asyncio.Future]] = []
+        # requests infeasible cluster-wide, parked until resources appear
+        # (parity: reference keeps infeasible tasks queued; here bounded by a
+        # grace deadline so callers get an explicit error eventually)
+        self.infeasible_queue: List[Tuple[Dict, asyncio.Future, float]] = []
         self.cluster_resources: Dict[str, Dict] = {}  # node hex -> view
         self.cluster_nodes: Dict[str, Dict] = {}  # node hex -> NodeInfo wire
         self._tasks: List[asyncio.Task] = []
@@ -102,7 +106,7 @@ class Raylet:
             "register_node",
             NodeInfo(
                 node_id=self.node_id,
-                raylet_addr="unix:" + self.sock_path,
+                raylet_addr=self.server.addr,
                 store_path=self.store_path,
                 resources=self.total_resources,
                 labels=self.labels,
@@ -114,6 +118,7 @@ class Raylet:
         )
         for n in snap.get("nodes", []):
             self._on_nodes_update([n])
+        self.cluster_resources = snap.get("resources") or {}
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         if GLOBAL_CONFIG.prestart_workers:
@@ -134,21 +139,9 @@ class Raylet:
             self.store.close()
 
     async def _connect_gcs(self) -> rpc.Connection:
-        path = self.gcs_addr.split(":", 1)[1]
-        deadline = time.monotonic() + 30
-        while True:
-            try:
-                reader, writer = await asyncio.open_unix_connection(path)
-                break
-            except (ConnectionRefusedError, FileNotFoundError):
-                if time.monotonic() > deadline:
-                    raise
-                await asyncio.sleep(0.05)
-        conn = rpc.Connection(
-            reader, writer, rpc.handler_table(self), name="raylet->gcs"
+        return await rpc.connect_async(
+            self.gcs_addr, rpc.handler_table(self), timeout=30, name="raylet->gcs"
         )
-        conn.start()
-        return conn
 
     # ------------- pubsub from GCS -------------
     async def rpc_publish(self, conn, data):
@@ -162,6 +155,30 @@ class Raylet:
     def _on_nodes_update(self, nodes: List[Dict]):
         for n in nodes:
             self.cluster_nodes[bytes(n["node_id"]).hex()] = n
+        self._pump_infeasible()
+
+    def _pump_infeasible(self, expire: bool = False):
+        """Re-evaluate parked lease requests after cluster topology changes."""
+        now = time.monotonic()
+        remaining = []
+        for summary, fut, deadline in self.infeasible_queue:
+            if fut.done():
+                continue
+            resources = summary.get("resources") or {}
+            # Local feasibility can change at runtime once placement-group
+            # bundle reservation mutates total_resources.
+            if self._feasible(resources):
+                self.lease_queue.append((summary, fut))
+                continue
+            target = self._pick_spillback(resources, strict=True)
+            if target:
+                fut.set_result({"spillback": target})
+            elif expire and now > deadline:
+                fut.set_result({"infeasible": True})
+            else:
+                remaining.append((summary, fut, deadline))
+        self.infeasible_queue = remaining
+        self._pump_lease_queue()
 
     async def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.health_check_period_ms / 1e3
@@ -178,6 +195,7 @@ class Raylet:
             except Exception:
                 if self._stopping:
                     return
+            self._pump_infeasible(expire=True)
             await asyncio.sleep(period)
 
     # ------------- worker pool -------------
@@ -192,7 +210,7 @@ class Raylet:
             sys.executable,
             "-m",
             "ray_tpu._private.worker_main",
-            "--raylet", "unix:" + self.sock_path,
+            "--raylet", self.server.addr,
             "--gcs", self.gcs_addr,
             "--store", self.store_path,
             "--node-id", self.node_id.hex(),
@@ -303,7 +321,13 @@ class Raylet:
             target = self._pick_spillback(resources, strict=True)
             if target:
                 return {"spillback": target}
-            return {"infeasible": True}
+            # Not feasible anywhere (yet): park until a node (re)appears.
+            fut = asyncio.get_running_loop().create_future()
+            grace = GLOBAL_CONFIG.infeasible_task_grace_s
+            self.infeasible_queue.append(
+                (summary, fut, time.monotonic() + grace)
+            )
+            return await fut
         if not self._can_fit_with_queue(resources):
             # Local node is (or will be, counting queued demand) saturated:
             # prefer an idle peer (hybrid pack-then-spread policy, parity:
@@ -317,16 +341,26 @@ class Raylet:
         return await fut
 
     def _pick_spillback(self, resources: Dict, strict: bool) -> Optional[str]:
-        """Pick another node with available (or feasible-total) capacity."""
+        """Pick another node with available (or feasible-total) capacity.
+
+        Strict (feasibility) checks use the *static* per-node totals from the
+        node table — present from registration, so a task submitted right
+        after a node joins is never declared infeasible while the first
+        heartbeat-gossiped resource view is still in flight.
+        """
         me = self.node_id.hex()
-        for nid_hex, view in self.cluster_resources.items():
-            if nid_hex == me:
+        for nid_hex, node in self.cluster_nodes.items():
+            if nid_hex == me or not node.get("alive", True):
                 continue
-            pool = view.get("available" if not strict else "total", {})
+            if strict:
+                pool = node.get("resources") or {}
+            else:
+                view = self.cluster_resources.get(nid_hex)
+                if view is None:
+                    continue
+                pool = view.get("available", {})
             if all(pool.get(r, 0.0) >= q for r, q in resources.items()):
-                node = self.cluster_nodes.get(nid_hex)
-                if node and node.get("alive", True):
-                    return node["raylet_addr"]
+                return node["raylet_addr"]
         return None
 
     def _pump_lease_queue(self):
@@ -479,8 +513,7 @@ class Raylet:
     async def _fetch_from_node(self, oid, raylet_addr: str) -> bool:
         """Chunked pull from a peer raylet into the local store."""
         try:
-            path = raylet_addr.split(":", 1)[1]
-            reader, writer = await asyncio.open_unix_connection(path)
+            reader, writer = await rpc.open_connection(raylet_addr)
             peer = rpc.Connection(reader, writer, rpc._null_handler,
                                   name="raylet-pull")
             peer.start()

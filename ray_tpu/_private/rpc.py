@@ -1,10 +1,16 @@
-"""Wire layer: length-prefixed msgpack RPC over unix sockets.
+"""Wire layer: length-prefixed msgpack RPC over unix or TCP sockets.
 
 Design parity: reference L1 (``src/ray/rpc/`` gRPC wrappers + per-process asio
 ``instrumented_io_context``).  Every process runs ONE IO event loop on a dedicated
 thread; all servers/clients in the process share it.  Calls from compute threads
 hop onto the loop via ``run_coroutine_threadsafe``.  Per-method latency/count stats
 are recorded (parity: grpc_server.h per-method stats, event_stats.h).
+
+Addresses are scheme-prefixed strings (parity: reference services.py:1353 hands
+the raylet host:port; grpc_server.h binds TCP):
+  ``unix:<path>``        same-host (fast path; the default for local clusters)
+  ``tcp:<host>:<port>``  cross-host / DCN (port 0 = kernel-assigned, read back
+                         from the bound socket after ``start_async``)
 
 Frame format: [u32 len][msgpack payload].
 Message: [kind, seqno, method, data]  kind: 0=request 1=reply 2=error 3=notify.
@@ -25,6 +31,24 @@ import msgpack
 _REQUEST, _REPLY, _ERROR, _NOTIFY = 0, 1, 2, 3
 
 _MAX_FRAME = 1 << 31
+
+
+def parse_addr(addr: str):
+    """Split a scheme-prefixed address into (scheme, rest)."""
+    if addr.startswith("unix:"):
+        return "unix", addr[5:]
+    if addr.startswith("tcp:"):
+        return "tcp", addr[4:]
+    raise ValueError(f"address must be unix:<path> or tcp:<host>:<port>: {addr!r}")
+
+
+async def open_connection(addr: str):
+    """asyncio (reader, writer) for either address scheme."""
+    scheme, rest = parse_addr(addr)
+    if scheme == "unix":
+        return await asyncio.open_unix_connection(rest)
+    host, port = rest.rsplit(":", 1)
+    return await asyncio.open_connection(host, int(port))
 
 
 class EventLoopThread:
@@ -237,10 +261,17 @@ def method_stats() -> MethodStats:
 
 
 class Server:
-    """Unix-socket RPC server living on the process IO loop."""
+    """RPC server (unix or TCP) living on the process IO loop.
 
-    def __init__(self, path: str, handler, name=""):
-        self.path = path
+    ``addr`` may be a bare path (treated as ``unix:<path>``) or a scheme
+    address.  After ``start_async``, ``self.addr`` holds the real bound
+    address (TCP port 0 is resolved to the kernel-assigned port).
+    """
+
+    def __init__(self, addr: str, handler, name=""):
+        if ":" not in addr or addr.startswith("/"):
+            addr = "unix:" + addr  # back-compat: bare socket path
+        self.addr = addr
         self.handler = handler
         self.name = name
         self.connections: list[Connection] = []
@@ -255,7 +286,18 @@ class Server:
         conn.start()
 
     async def start_async(self):
-        self._server = await asyncio.start_unix_server(self._on_client, path=self.path)
+        scheme, rest = parse_addr(self.addr)
+        if scheme == "unix":
+            self._server = await asyncio.start_unix_server(
+                self._on_client, path=rest
+            )
+        else:
+            host, port = rest.rsplit(":", 1)
+            self._server = await asyncio.start_server(
+                self._on_client, host=host, port=int(port)
+            )
+            real_port = self._server.sockets[0].getsockname()[1]
+            self.addr = f"tcp:{host}:{real_port}"
 
     async def stop_async(self):
         if self._server is not None:
@@ -276,24 +318,11 @@ class Client:
         self.io = io
 
     @classmethod
-    def connect(cls, path: str, handler=None, timeout=30.0, name="") -> "Client":
+    def connect(cls, addr: str, handler=None, timeout=30.0, name="") -> "Client":
+        if ":" not in addr or addr.startswith("/"):
+            addr = "unix:" + addr  # back-compat: bare socket path
         io = EventLoopThread.get()
-
-        async def _connect():
-            deadline = time.monotonic() + timeout
-            while True:
-                try:
-                    reader, writer = await asyncio.open_unix_connection(path)
-                    break
-                except (ConnectionRefusedError, FileNotFoundError):
-                    if time.monotonic() > deadline:
-                        raise
-                    await asyncio.sleep(0.05)
-            conn = Connection(reader, writer, handler or _null_handler, name=name)
-            conn.start()
-            return conn
-
-        return cls(io.run(_connect()), io)
+        return cls(io.run(connect_async(addr, handler, timeout, name)), io)
 
     def call(self, method: str, data: Any = None, timeout=None) -> Any:
         return self.io.run(self.conn.call_async(method, data, timeout=timeout))
@@ -308,6 +337,24 @@ class Client:
     @property
     def closed(self):
         return self.conn.closed
+
+
+async def connect_async(addr: str, handler=None, timeout=30.0, name="") -> Connection:
+    """Connect with retry (server may still be binding). Runs on the IO loop."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            reader, writer = await open_connection(addr)
+            break
+        except (ConnectionRefusedError, FileNotFoundError):
+            # transient during daemon bootstrap; permanent errors (DNS,
+            # permissions) raise immediately
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.05)
+    conn = Connection(reader, writer, handler or _null_handler, name=name)
+    conn.start()
+    return conn
 
 
 async def _null_handler(conn, method, data):

@@ -33,6 +33,7 @@ global_worker = Worker()
 
 def init(
     *,
+    address: Optional[str] = None,
     num_cpus: Optional[float] = None,
     num_tpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
@@ -40,7 +41,12 @@ def init(
     system_config: Optional[Dict] = None,
     _node_defaults: bool = True,
 ) -> Dict:
-    """Start a local cluster (GCS + raylet) and connect this process as driver."""
+    """Start a local cluster (GCS + raylet) and connect this process as driver.
+
+    ``address="tcp:<head-ip>:<port>"`` instead joins an existing cluster's GCS
+    (parity: ray.init(address=...) — a local raylet is started and registered
+    against the remote head).
+    """
     if global_worker.connected:
         logger.warning("ray_tpu.init() called twice; ignoring")
         return {}
@@ -60,8 +66,9 @@ def init(
         if n:
             res["TPU"] = float(n)
 
-    cluster = Cluster()
-    cluster.start_gcs(system_config)
+    cluster = Cluster(gcs_address=address)
+    if address is None:
+        cluster.start_gcs(system_config)
     cluster.add_node(resources=res, head=True)
     global_worker.cluster = cluster
     connect(

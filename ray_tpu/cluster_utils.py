@@ -24,10 +24,16 @@ class Cluster:
         head_node_args: Optional[Dict] = None,
         connect: bool = False,
         system_config: Optional[Dict] = None,
+        use_tcp: bool = False,
+        gcs_address: Optional[str] = None,
+        node_ip: Optional[str] = None,
     ):
         GLOBAL_CONFIG.initialize(system_config)
-        self._impl = node_mod.Cluster()
-        self._impl.start_gcs(system_config)
+        self._impl = node_mod.Cluster(
+            use_tcp=use_tcp, gcs_address=gcs_address, node_ip=node_ip
+        )
+        if gcs_address is None:
+            self._impl.start_gcs(system_config)
         self.head_node: Optional[NodeHandle] = None
         if initialize_head:
             self.head_node = self._impl.add_node(
