@@ -118,8 +118,9 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
 
 def get_actor(name: str) -> ActorHandle:
     cw = require_connected()
-    actor_id = cw.get_named_actor(name)
-    return ActorHandle(actor_id, name)
+    rec = cw.get_named_actor(name)
+    return ActorHandle(rec["actor_id"], name,
+                       method_meta=rec.get("method_meta") or {})
 
 
 def nodes() -> List[Dict]:

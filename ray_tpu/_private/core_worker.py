@@ -42,20 +42,61 @@ MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
 
+class _StorePin:
+    """Owns one outstanding store refcount for a sealed object; released when
+    the last deserialized view dies (see serialization._PinnedSlice)."""
+
+    __slots__ = ("_store", "_oid", "_released")
+
+    def __init__(self, store, oid):
+        self._store = store
+        self._oid = oid
+        self._released = False
+
+    def release_now(self):
+        if not self._released:
+            self._released = True
+            try:
+                self._store.release(self._oid)
+            except Exception:
+                pass
+
+    def __del__(self):
+        self.release_now()
+
+
 class _PendingObject:
-    __slots__ = ("event", "kind", "value", "locations")
+    __slots__ = ("event", "kind", "value", "locations", "_listeners", "_lock")
 
     def __init__(self):
         self.event = threading.Event()
         self.kind = None  # "value" | "plasma" | "error"
         self.value = None
         self.locations = []
+        self._listeners = []
+        self._lock = threading.Lock()
 
     def resolve(self, kind, value=None, locations=()):
         self.kind = kind
         self.value = value
         self.locations = list(locations)
         self.event.set()
+        with self._lock:
+            cbs, self._listeners = self._listeners, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def add_listener(self, cb):
+        """cb fires (from the resolving thread) when the entry resolves; fires
+        immediately if already resolved. Used for event-driven get/wait."""
+        with self._lock:
+            if not self.event.is_set():
+                self._listeners.append(cb)
+                return
+        cb()
 
 
 class MemoryStore:
@@ -156,6 +197,18 @@ class CoreWorker:
         self._refcounts: Dict[ObjectID, int] = collections.defaultdict(int)
         self._owned: set = set()
         self._ref_lock = threading.Lock()
+        # -- distributed borrowing (parity: reference_count.h:61) --
+        # owner side: oid -> worker_ids borrowing it; frees deferred while set
+        self._borrowers: Dict[ObjectID, set] = {}
+        self._borrower_conns: Dict[Any, set] = {}  # conn -> {(oid, wid)}
+        self._deferred_free: set = set()
+        # borrower side: oids whose owner we've registered with
+        self._borrowing: set = set()
+        # containment: outer oid -> ObjectRefs its serialized value contains
+        self._contained: Dict[ObjectID, List] = {}
+        # sender-side handoff pins: (expiry, refs) — keeps refs alive while a
+        # reply carrying them is in flight and the receiver registers borrows
+        self._handoff_pins: collections.deque = collections.deque()
 
         # task manager (owner side)
         self._pending_tasks: Dict[bytes, Dict] = {}
@@ -203,8 +256,23 @@ class CoreWorker:
 
     # ================= reference counting =================
     def _on_ref_created(self, ref: ObjectRef):
+        first = False
         with self._ref_lock:
             self._refcounts[ref.id] += 1
+            first = self._refcounts[ref.id] == 1
+        if first and not self._shutdown.is_set():
+            owner = ref.owner_address
+            if (
+                owner
+                and bytes(owner[0]) != self.worker_id
+                and ref.id not in self._owned
+                and ref.id not in self._borrowing
+            ):
+                # First sight of someone else's ref: we are now a borrower.
+                # Register with the owner so it defers the free while we
+                # hold it (parity: reference borrowing protocol).
+                self._borrowing.add(ref.id)
+                self.io.submit(self._send_borrow(ref, add=True))
 
     def _on_ref_deleted(self, ref: ObjectRef):
         with self._ref_lock:
@@ -215,13 +283,72 @@ class CoreWorker:
             else:
                 self._refcounts[ref.id] = n
                 return
-        if owned and not self._shutdown.is_set():
-            self._free_object(ref.id)
+        if self._shutdown.is_set():
+            return
+        if owned:
+            if self._borrowers.get(ref.id):
+                self._deferred_free.add(ref.id)  # freed when borrowers drain
+            else:
+                self._free_object(ref.id)
+        elif ref.id in self._borrowing:
+            self._borrowing.discard(ref.id)
+            self.io.submit(self._send_borrow(ref, add=False))
+
+    async def _send_borrow(self, ref: ObjectRef, add: bool):
+        try:
+            conn = await self._conn_to(ref.owner_address[1])
+            await conn.call_async(
+                "add_borrower" if add else "remove_borrower",
+                [ref.binary(), self.worker_id],
+                timeout=30,
+            )
+        except Exception as e:
+            logger.debug("borrow %s notify failed for %s: %s",
+                         "add" if add else "remove", ref.hex()[:12], e)
+
+    async def rpc_add_borrower(self, conn, data):
+        oid_bytes, borrower_id = data
+        oid = ObjectID(bytes(oid_bytes))
+        if oid not in self._owned:
+            return False  # already freed; the borrower gets no protection
+        self._borrowers.setdefault(oid, set()).add(bytes(borrower_id))
+        # Borrows die with the borrower's connection: a killed worker can't
+        # send remove_borrower, and a leaked borrow would pin the object (and
+        # its store bytes) forever.
+        if conn not in self._borrower_conns:
+            self._borrower_conns[conn] = set()
+            conn.add_close_callback(self._on_borrower_conn_close)
+        self._borrower_conns[conn].add((oid, bytes(borrower_id)))
+        return True
+
+    def _drop_borrow(self, oid: ObjectID, borrower_id: bytes):
+        s = self._borrowers.get(oid)
+        if s is not None:
+            s.discard(borrower_id)
+            if not s:
+                self._borrowers.pop(oid, None)
+                if oid in self._deferred_free:
+                    self._deferred_free.discard(oid)
+                    self._free_object(oid)
+
+    async def rpc_remove_borrower(self, conn, data):
+        oid_bytes, borrower_id = data
+        self._drop_borrow(ObjectID(bytes(oid_bytes)), bytes(borrower_id))
+        entries = self._borrower_conns.get(conn)
+        if entries is not None:
+            entries.discard((ObjectID(bytes(oid_bytes)), bytes(borrower_id)))
+        return True
+
+    def _on_borrower_conn_close(self, conn):
+        for oid, borrower_id in self._borrower_conns.pop(conn, set()):
+            self._drop_borrow(oid, borrower_id)
 
     def _free_object(self, oid: ObjectID):
         self.memory_store.pop(oid)
         self._owned.discard(oid)
         self._lineage.pop(oid, None)
+        self._deferred_free.discard(oid)
+        self._contained.pop(oid, None)  # drop containment pins (inner refs)
         try:
             if self.store.contains(oid):
                 self.store.delete(oid)
@@ -237,8 +364,20 @@ class CoreWorker:
         except Exception:
             pass
 
+    def _pin_handoff(self, refs: List, ttl: float = 60.0):
+        """Keep refs alive across a reply's flight so the receiver can
+        register its borrow with the owner before any free can land."""
+        if refs:
+            self._handoff_pins.append((time.monotonic() + ttl, refs))
+
+    def _prune_handoff_pins(self):
+        now = time.monotonic()
+        while self._handoff_pins and self._handoff_pins[0][0] < now:
+            self._handoff_pins.popleft()
+
     # ================= serialization helpers =================
-    def _put_to_plasma(self, oid: ObjectID, value) -> None:
+    def _write_to_store(self, oid: ObjectID, value) -> None:
+        """Serialize + seal into the local shared-memory store (no GCS I/O)."""
         meta, views, total = serialization.packed_size(value)
         try:
             buf = self.store.create_buffer(oid, total)
@@ -252,25 +391,40 @@ class CoreWorker:
             del buf
         self.store.seal(oid)
         self.store.release(oid)
+
+    def _put_to_plasma(self, oid: ObjectID, value) -> None:
+        """Blocking variant for compute threads (NOT the IO loop)."""
+        self._write_to_store(oid, value)
         self.gcs.call("add_object_location", [oid.binary(), self.node_id])
 
     def put(self, value, _owner_inline=False) -> ObjectRef:
         """ray.put: store in the local shared-memory store; owner = self."""
         oid = ObjectID.for_put()
         self._put_to_plasma(oid, value)
+        contained = serialization.take_contained_refs()
+        if contained:
+            # The stored bytes reference these objects: keep them alive for
+            # the outer object's lifetime (containment edge).
+            self._contained[oid] = contained
         self._owned.add(oid)
         self.memory_store.put_plasma(oid, [self.node_id])
         return ObjectRef(oid, self.address.to_wire())
 
     # ================= get =================
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
+        """Event-driven get: blocks on entry-resolution callbacks, not a busy
+        poll (parity: reference CoreWorker::Get blocks in the memory store /
+        plasma with wakeups). A 0.2s backstop re-arms pulls after failures."""
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[int, Any] = {}
         remaining = {i: r for i, r in enumerate(refs)}
         requested_pull: Dict[ObjectID, float] = {}
+        wake = threading.Event()
+        listening: set = set()
         while remaining:
+            wake.clear()
             for i, ref in list(remaining.items()):
-                val = self._try_get_one(ref, requested_pull)
+                val = self._try_get_one(ref, requested_pull, wake, listening)
                 if val is not _NOT_READY:
                     results[i] = val
                     del remaining[i]
@@ -280,7 +434,10 @@ class CoreWorker:
                 raise exc.GetTimeoutError(
                     f"Get timed out on {len(remaining)} of {len(refs)} objects"
                 )
-            time.sleep(0.002)
+            budget = 0.25 if deadline is None else min(
+                0.25, max(0.0, deadline - time.monotonic())
+            )
+            wake.wait(budget)
         out = []
         for i in range(len(refs)):
             v = results[i]
@@ -289,7 +446,8 @@ class CoreWorker:
             out.append(v)
         return out
 
-    def _try_get_one(self, ref: ObjectRef, requested_pull: set):
+    def _try_get_one(self, ref: ObjectRef, requested_pull, wake=None,
+                     listening=None):
         e = self.memory_store.get(ref.id)
         if e is not None and e.event.is_set():
             if e.kind == "value":
@@ -297,21 +455,30 @@ class CoreWorker:
             if e.kind == "error":
                 return _Err(e.value)
             # plasma
-            return self._read_plasma(ref, requested_pull)
+            return self._read_plasma(ref, requested_pull, wake, listening)
         if e is None:
             # Not a known pending return: plasma-or-remote path.
-            return self._read_plasma(ref, requested_pull)
+            return self._read_plasma(ref, requested_pull, wake, listening)
+        if wake is not None and ref.id not in listening:
+            listening.add(ref.id)
+            e.add_listener(wake.set)
         return _NOT_READY
 
-    def _read_plasma(self, ref: ObjectRef, requested_pull: set):
+    def _read_plasma(self, ref: ObjectRef, requested_pull, wake=None,
+                     listening=None):
         view = self.store.get(ref.id, timeout=0)
         if view is not None:
+            # The store ref taken by get() is owned by `pin`: it lives until
+            # every zero-copy view deserialized from the buffer dies, so LRU
+            # eviction can't reuse the bytes under live numpy arrays
+            # (ADVICE r1: use-after-free under memory pressure).
+            pin = _StorePin(self.store, ref.id)
             try:
-                value = serialization.unpack(view)
-            finally:
-                # Note: numpy views over the buffer keep the mapping alive;
-                # release the store ref only after unpack created its views.
-                self.store.release(ref.id)
+                value = serialization.unpack(view, pin=pin)
+            except BaseException:
+                pin.release_now()
+                raise
+            del pin  # dropped with the last view (or right here if none)
             if isinstance(value, exc.ErrorObject):
                 return _Err(value.error)
             return value
@@ -328,20 +495,18 @@ class CoreWorker:
         # Time-based re-request: pulls are idempotent, and one-shot request
         # tracking can stall if a failure is cleared while no pull is in
         # flight (e.g. right as a reconstruction completes).
-        now = time.monotonic()
-        last = requested_pull.get(ref.id, 0.0) if isinstance(requested_pull, dict) else 0.0
-        if now - last > 0.2:
-            requested_pull[ref.id] = now
-            self.io.submit(self._pull_async(ref))
+        self._request_pull(ref, requested_pull, wake)
         return _NOT_READY
 
-    async def _pull_async(self, ref: ObjectRef):
+    async def _pull_async(self, ref: ObjectRef, wake=None):
         try:
             ok = await self.raylet.conn.call_async(
                 "pull_object", ref.binary(), timeout=60
             )
             if ok:
                 self._pull_failures.pop(ref.id, None)
+                if wake is not None:
+                    wake.set()
                 return
             # Fall back to asking the owner directly (memory-store values).
             owner = ref.owner_address
@@ -355,11 +520,16 @@ class CoreWorker:
                     else:
                         self.memory_store.put_value(ref.id, value)
                     self._pull_failures.pop(ref.id, None)
+                    if wake is not None:
+                        wake.set()
                     return
             self._pull_failures[ref.id] += 1
         except Exception as e:
             logger.debug("pull failed for %s: %s", ref.hex()[:12], e)
             self._pull_failures[ref.id] += 1
+        finally:
+            if wake is not None:
+                wake.set()  # wake the getter to re-evaluate (failure counting)
 
     # ---- lineage reconstruction (parity: reference ObjectRecoveryManager
     # object_recovery_manager.h:41 + TaskManager::ResubmitTask task_manager.h:234;
@@ -402,11 +572,17 @@ class CoreWorker:
 
     # ================= wait =================
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        """Event-driven wait (same wakeup scheme as get). Borrowed refs with
+        no local entry are actively pulled so a remotely-ready object counts
+        as ready (ADVICE r1: wait() used to block on them until timeout)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
         requested: Dict[ObjectID, float] = {}
+        wake = threading.Event()
+        listening: set = set()
         while True:
+            wake.clear()
             still = []
             for ref in pending:
                 e = self.memory_store.get(ref.id)
@@ -416,15 +592,23 @@ class CoreWorker:
                     # Object exists remotely: that's "ready" per reference
                     # semantics; fetch_local additionally pulls the value.
                     if fetch_local:
-                        now = time.monotonic()
-                        if now - requested.get(ref.id, 0.0) > 0.2:
-                            requested[ref.id] = now
-                            self.io.submit(self._pull_async(ref))
+                        self._request_pull(ref, requested, wake)
                         done = False  # wait for the local copy
                     else:
                         done = True
+                elif e is None and not local:
+                    # Unknown here (borrowed ref, no entry): resolve by
+                    # pulling — the pull lands it locally (or its owner value
+                    # in the memory store), flipping it to ready. Entries that
+                    # exist but are unresolved are OUR pending task returns:
+                    # pulling those would only rack up pull failures.
+                    self._request_pull(ref, requested, wake)
+                    done = False
                 else:
                     done = resolved or local
+                    if not done and e is not None and ref.id not in listening:
+                        listening.add(ref.id)
+                        e.add_listener(wake.set)
                 if done:
                     ready.append(ref)
                 else:
@@ -434,8 +618,17 @@ class CoreWorker:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.002)
+            budget = 0.25 if deadline is None else min(
+                0.25, max(0.0, deadline - time.monotonic())
+            )
+            wake.wait(budget)
         return ready, pending
+
+    def _request_pull(self, ref: ObjectRef, requested: Dict, wake=None):
+        now = time.monotonic()
+        if now - requested.get(ref.id, 0.0) > 0.2:
+            requested[ref.id] = now
+            self.io.submit(self._pull_async(ref, wake))
 
     # ================= function table =================
     def _export(self, prefix: str, obj) -> bytes:
@@ -470,6 +663,7 @@ class CoreWorker:
         plasma promotions of large values) must outlive the task: the caller
         stores them in the pending-task record so GC can't free the objects
         before the executor reads them."""
+        self._prune_handoff_pins()  # drivers prune here; workers in exec loop
         wire, pinned = [], []
         for a in args_values:
             if isinstance(a, ObjectRef):
@@ -477,6 +671,8 @@ class CoreWorker:
                 pinned.append(a)
             else:
                 packed = serialization.pack(a)
+                # Refs nested inside the value must outlive the task too.
+                pinned.extend(serialization.take_contained_refs())
                 if len(packed) > GLOBAL_CONFIG.inline_object_max_bytes:
                     ref = self.put(a)
                     wire.append(["r", ref.binary(), ref.owner_address])
@@ -546,6 +742,21 @@ class CoreWorker:
         st.queue.append(spec)
         self._maybe_request_lease(key, st)
 
+    async def _wait_entry(self, e: _PendingObject):
+        """Await entry resolution on the IO loop without polling."""
+        if e.event.is_set():
+            return
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def _on_resolve():
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None)
+            )
+
+        e.add_listener(_on_resolve)
+        await fut
+
     async def _resolve_dependencies(self, spec: TaskSpec):
         """Inline small owned values; leave plasma refs for the executor."""
         for i, a in enumerate(spec.args):
@@ -555,14 +766,18 @@ class CoreWorker:
             e = self.memory_store.get(oid)
             if e is None:
                 continue  # borrowed / plasma ref: executor will fetch
-            while not e.event.is_set():
-                await asyncio.sleep(0.001)
+            await self._wait_entry(e)
             if e.kind == "value":
                 packed = serialization.pack(e.value)
                 if len(packed) <= GLOBAL_CONFIG.inline_object_max_bytes:
                     spec.args[i] = ["v", packed]
                 else:
-                    self._put_to_plasma(oid, e.value)
+                    # NOTE: runs on the IO loop — must use the async GCS call
+                    # (the sync facade would deadlock the loop, ADVICE r1).
+                    self._write_to_store(oid, e.value)
+                    await self.gcs.conn.call_async(
+                        "add_object_location", [oid.binary(), self.node_id]
+                    )
                     e.kind = "plasma"
             elif e.kind == "error":
                 raise e.value
@@ -582,10 +797,13 @@ class CoreWorker:
             grant = None
             for _hop in range(8):  # bounded spillback chain
                 try:
+                    # No client timeout: the raylet queues indefinitely and
+                    # reclaims via conn death — a timed-out-but-later-granted
+                    # lease would leak the worker (ADVICE r1).
                     reply = await raylet_conn.call_async(
                         "request_worker_lease",
                         {"resources": resources},
-                        timeout=300,
+                        timeout=None,
                     )
                 except Exception:
                     return
@@ -665,10 +883,19 @@ class CoreWorker:
             info["retries_left"] -= 1
             self.io.submit(self._submit_async(spec))
             return
-        for oid_bytes, (kind, payload) in zip(
+        contained_map = reply.get("contained") or {}
+        for idx, (oid_bytes, (kind, payload)) in enumerate(zip(
             [r.binary() for r in spec.return_ids()], returns
-        ):
+        )):
             oid = ObjectID(oid_bytes)
+            contained = contained_map.get(str(idx))
+            if contained:
+                # As the return's owner, hold the inner refs for the outer
+                # object's lifetime (registers our borrow with their owners).
+                self._contained[oid] = [
+                    ObjectRef(ObjectID(bytes(b)), owner)
+                    for b, owner in contained
+                ]
             if kind == "v":
                 value = serialization.unpack(payload)
                 if isinstance(value, exc.ErrorObject):
@@ -679,6 +906,10 @@ class CoreWorker:
                 self.memory_store.put_plasma(oid, [worker_addr[2]])
         info = self._pending_tasks.pop(spec.task_id, None)
         self._recovering.discard(spec.task_id)
+        if info and info.get("pinned"):
+            # Keep arg refs alive past the reply: the executor's add_borrower
+            # for them may still be in flight on another connection.
+            self._pin_handoff(info["pinned"])
         if GLOBAL_CONFIG.lineage_pinning_enabled:
             for r in spec.return_ids():
                 self._lineage[r] = spec
@@ -700,7 +931,9 @@ class CoreWorker:
         self._fail_task(spec, exc.WorkerCrashedError(str(error)))
 
     def _fail_task(self, spec: TaskSpec, error: BaseException):
-        self._pending_tasks.pop(spec.task_id, None)
+        info = self._pending_tasks.pop(spec.task_id, None)
+        if info and info.get("pinned"):
+            self._pin_handoff(info["pinned"])
         if not isinstance(error, exc.RayTpuError):
             error = exc.TaskError(
                 function_name=spec.name, traceback_str=str(error), cause=error
@@ -734,6 +967,7 @@ class CoreWorker:
         max_concurrency: int = 1,
         scheduling_strategy=None,
         pinned=None,
+        method_meta: Optional[Dict] = None,
     ) -> bytes:
         cid = self._export("cls", cls)
         actor_id = ActorID.from_random().binary()
@@ -755,6 +989,7 @@ class CoreWorker:
         )
         wire = spec.to_wire()
         wire["name_register"] = actor_name
+        wire["method_meta"] = method_meta or {}
         if pinned:
             self._actor_pinned[actor_id] = pinned
         reply = self.gcs.call("create_actor", wire)
@@ -909,7 +1144,7 @@ class CoreWorker:
         rec = self.gcs.call("get_named_actor", name)
         if rec is None or rec["state"] == "DEAD":
             raise ValueError(f"Failed to look up actor with name {name!r}")
-        return rec["actor_id"]
+        return rec
 
     # ================= execution (worker side) =================
     async def rpc_push_task(self, conn, spec_wire: Dict):
@@ -932,6 +1167,7 @@ class CoreWorker:
     def execution_loop(self):
         """Run on the worker's MAIN thread (owns JAX/device runtime)."""
         while not self._shutdown.is_set():
+            self._prune_handoff_pins()
             try:
                 item = self._exec_queue.get(timeout=0.1)
             except queue_mod.Empty:
@@ -1006,8 +1242,17 @@ class CoreWorker:
                     f"expected {spec.num_returns}"
                 )
         returns = []
-        for oid, value in zip(spec.return_ids(), values):
+        contained_map: Dict[int, List] = {}
+        for idx, (oid, value) in enumerate(zip(spec.return_ids(), values)):
             meta, views, total = serialization.packed_size(value)
+            contained = serialization.take_contained_refs()
+            if contained:
+                # Ship containment edges to the return's owner (the caller)
+                # and pin locally until the caller registers its borrows.
+                contained_map[str(idx)] = [
+                    [r.binary(), r.owner_address] for r in contained
+                ]
+                self._pin_handoff(contained)
             if total > GLOBAL_CONFIG.inline_object_max_bytes:
                 buf = self.store.create_buffer(oid, total)
                 try:
@@ -1022,7 +1267,10 @@ class CoreWorker:
                 out = bytearray(total)
                 serialization.pack_into(meta, views, memoryview(out))
                 returns.append(["v", bytes(out)])
-        return {"returns": returns}
+        reply = {"returns": returns}
+        if contained_map:
+            reply["contained"] = contained_map
+        return reply
 
     # ================= shutdown =================
     def shutdown(self):

@@ -58,6 +58,7 @@ class ActorRecord:
             "num_restarts": self.num_restarts,
             "name": self.name,
             "death_cause": self.death_cause,
+            "method_meta": self.spec.get("method_meta") or {},
         }
 
 

@@ -7,9 +7,29 @@ value; registered with the core worker for local reference counting.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 from ray_tpu._private.ids import ObjectID
+
+_tls = threading.local()
+
+
+class collect_refs:
+    """Context manager capturing every ObjectRef pickled within (per-thread).
+
+    Used by the serializer to learn which refs a value *contains* — the
+    containment edges of the distributed refcount (parity: reference
+    ReferenceCounter nested-ref tracking, reference_count.h:61)."""
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "collector", None)
+        _tls.collector = []
+        return _tls.collector
+
+    def __exit__(self, *a):
+        _tls.collector = self._prev
+        return False
 
 
 class ObjectRef:
@@ -44,6 +64,9 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        c = getattr(_tls, "collector", None)
+        if c is not None:
+            c.append(self)
         return (_deserialize_ref, (self._id.binary(), self._owner))
 
     def __del__(self):

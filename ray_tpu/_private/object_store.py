@@ -136,8 +136,14 @@ class SharedMemoryStore:
             self._lib.rt_store_detach(self._base)
             self._base = 0
 
+    @property
+    def closed(self) -> bool:
+        return not self._base
+
     # -- object ops --
     def create_buffer(self, oid: ObjectID, size: int) -> memoryview:
+        if not self._base:
+            raise StoreFullError("store closed")
         off = self._lib.rt_store_create(self._base, oid.binary(), size)
         if off == -1:
             raise StoreFullError(f"object store full allocating {size} bytes")
@@ -148,11 +154,15 @@ class SharedMemoryStore:
         return self._view[off : off + size]
 
     def seal(self, oid: ObjectID):
+        if not self._base:
+            raise RuntimeError("store closed")
         rc = self._lib.rt_store_seal(self._base, oid.binary())
         if rc != 0:
             raise RuntimeError(f"seal failed for {oid.hex()}")
 
     def abort(self, oid: ObjectID):
+        if not self._base:
+            return
         self._lib.rt_store_abort(self._base, oid.binary())
 
     def put(self, oid: ObjectID, data) -> None:
@@ -165,6 +175,8 @@ class SharedMemoryStore:
     def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
         """Returns a zero-copy view (caller must release(oid) when done), or
         None if not present within timeout."""
+        if not self._base:
+            return None
         size = ctypes.c_uint64()
         off = self._lib.rt_store_get(
             self._base, oid.binary(), ctypes.byref(size), float(timeout or 0)
@@ -175,15 +187,26 @@ class SharedMemoryStore:
         return self._view[off : off + size.value].toreadonly()
 
     def release(self, oid: ObjectID):
+        # After close() the arena is detached; outstanding pins (zero-copy
+        # views still alive in user code) must no-op, not touch freed memory.
+        if not self._base:
+            return
         self._lib.rt_store_release(self._base, oid.binary())
 
     def delete(self, oid: ObjectID):
+        if not self._base:
+            return
         self._lib.rt_store_delete(self._base, oid.binary())
 
     def contains(self, oid: ObjectID) -> bool:
+        if not self._base:
+            return False
         return bool(self._lib.rt_store_contains(self._base, oid.binary()))
 
     def stats(self) -> dict:
+        if not self._base:
+            return {"bytes_allocated": 0, "arena_size": 0,
+                    "num_objects": 0, "num_evictions": 0}
         vals = [ctypes.c_uint64() for _ in range(4)]
         self._lib.rt_store_stats(self._base, *[ctypes.byref(v) for v in vals])
         return {

@@ -51,10 +51,12 @@ class WorkerHandle:
 
 
 class Lease:
-    def __init__(self, lease_id: bytes, worker: WorkerHandle, resources: Dict):
+    def __init__(self, lease_id: bytes, worker: WorkerHandle, resources: Dict,
+                 owner_conn=None):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
+        self.owner_conn = owner_conn  # requesting conn; reclaim on its death
         self.granted_at = time.monotonic()
 
 
@@ -85,12 +87,15 @@ class Raylet:
         self.idle: List[WorkerHandle] = []
         self.leases: Dict[bytes, Lease] = {}
         self.drivers: Dict[bytes, rpc.Connection] = {}
-        # lease queue: (spec_summary, future)
-        self.lease_queue: List[Tuple[Dict, asyncio.Future]] = []
+        # lease queue: (spec_summary, future, owner_conn)
+        self.lease_queue: List[Tuple[Dict, asyncio.Future, Any]] = []
         # requests infeasible cluster-wide, parked until resources appear
         # (parity: reference keeps infeasible tasks queued; here bounded by a
         # grace deadline so callers get an explicit error eventually)
-        self.infeasible_queue: List[Tuple[Dict, asyncio.Future, float]] = []
+        self.infeasible_queue: List[Tuple[Dict, asyncio.Future, float, Any]] = []
+        # conn -> lease_ids granted to it; reclaimed when the conn dies so an
+        # abandoned/dead owner can't strand workers+resources (ADVICE r1)
+        self._owner_leases: Dict[Any, Set[bytes]] = {}
         self.cluster_resources: Dict[str, Dict] = {}  # node hex -> view
         self.cluster_nodes: Dict[str, Dict] = {}  # node hex -> NodeInfo wire
         self._tasks: List[asyncio.Task] = []
@@ -161,14 +166,14 @@ class Raylet:
         """Re-evaluate parked lease requests after cluster topology changes."""
         now = time.monotonic()
         remaining = []
-        for summary, fut, deadline in self.infeasible_queue:
+        for summary, fut, deadline, conn in self.infeasible_queue:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
             # Local feasibility can change at runtime once placement-group
             # bundle reservation mutates total_resources.
             if self._feasible(resources):
-                self.lease_queue.append((summary, fut))
+                self.lease_queue.append((summary, fut, conn))
                 continue
             target = self._pick_spillback(resources, strict=True)
             if target:
@@ -176,7 +181,7 @@ class Raylet:
             elif expire and now > deadline:
                 fut.set_result({"infeasible": True})
             else:
-                remaining.append((summary, fut, deadline))
+                remaining.append((summary, fut, deadline, conn))
         self.infeasible_queue = remaining
         self._pump_lease_queue()
 
@@ -261,6 +266,10 @@ class Raylet:
             self.idle.remove(w)
         if w.lease_id is not None and w.lease_id in self.leases:
             lease = self.leases.pop(w.lease_id)
+            if lease.owner_conn is not None:
+                s = self._owner_leases.get(lease.owner_conn)
+                if s is not None:
+                    s.discard(lease.lease_id)
             self._release_resources(lease.resources)
         if w.actor_id is not None and not self._stopping:
             try:
@@ -282,7 +291,7 @@ class Raylet:
     def _can_fit_with_queue(self, resources: Dict[str, float]) -> bool:
         """Would this request fit after already-queued demand is served?"""
         queued: Dict[str, float] = {}
-        for summary, fut in self.lease_queue:
+        for summary, fut, _conn in self.lease_queue:
             if fut.done():
                 continue
             for r, q in (summary.get("resources") or {}).items():
@@ -325,8 +334,9 @@ class Raylet:
             fut = asyncio.get_running_loop().create_future()
             grace = GLOBAL_CONFIG.infeasible_task_grace_s
             self.infeasible_queue.append(
-                (summary, fut, time.monotonic() + grace)
+                (summary, fut, time.monotonic() + grace, conn)
             )
+            self._watch_owner(conn)
             return await fut
         if not self._can_fit_with_queue(resources):
             # Local node is (or will be, counting queued demand) saturated:
@@ -336,9 +346,43 @@ class Raylet:
             if target:
                 return {"spillback": target}
         fut = asyncio.get_running_loop().create_future()
-        self.lease_queue.append((summary, fut))
+        self.lease_queue.append((summary, fut, conn))
+        self._watch_owner(conn)
         self._pump_lease_queue()
         return await fut
+
+    def _watch_owner(self, conn):
+        """Ensure an owner conn has a close handler reclaiming its leases and
+        cancelling its queued lease requests."""
+        if conn is None or conn in self._owner_leases:
+            return
+        self._owner_leases[conn] = set()
+        conn.add_close_callback(self._on_owner_conn_close)
+
+    def _on_owner_conn_close(self, conn):
+        lease_ids = self._owner_leases.pop(conn, set())
+        for lid in lease_ids:
+            lease = self.leases.pop(lid, None)
+            if lease is None:
+                continue
+            self._release_resources(lease.resources)
+            w = lease.worker
+            w.lease_id = None
+            # The owner died mid-lease: the worker may be running a task whose
+            # owner no longer exists — kill it (pool replenishes).
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        for _, fut, c in self.lease_queue:
+            if c is conn and not fut.done():
+                fut.cancel()
+        remaining = []
+        for it in self.infeasible_queue:
+            if it[3] is conn:
+                it[1].cancel()
+            else:
+                remaining.append(it)
+        self.infeasible_queue = remaining
+        self._pump_lease_queue()
 
     def _pick_spillback(self, resources: Dict, strict: bool) -> Optional[str]:
         """Pick another node with available (or feasible-total) capacity.
@@ -367,23 +411,26 @@ class Raylet:
         if self._stopping:
             return
         remaining = []
-        for summary, fut in self.lease_queue:
+        for summary, fut, conn in self.lease_queue:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
             if not self._can_fit(resources):
-                remaining.append((summary, fut))
+                remaining.append((summary, fut, conn))
                 continue
             tpu_needed = resources.get("TPU", 0) > 0
             w = self._pop_idle_worker(tpu_needed)
             if w is None:
-                remaining.append((summary, fut))
+                remaining.append((summary, fut, conn))
                 self._maybe_spawn_worker(tpu_needed)
                 continue
             lease_id = os.urandom(16)
             self._acquire_resources(resources)
             w.lease_id = lease_id
-            self.leases[lease_id] = Lease(lease_id, w, resources)
+            self.leases[lease_id] = Lease(lease_id, w, resources,
+                                          owner_conn=conn)
+            if conn is not None:
+                self._owner_leases.setdefault(conn, set()).add(lease_id)
             fut.set_result(
                 {
                     "granted": True,
@@ -418,6 +465,10 @@ class Raylet:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return False
+        if lease.owner_conn is not None:
+            s = self._owner_leases.get(lease.owner_conn)
+            if s is not None:
+                s.discard(lease_id)
         self._release_resources(lease.resources)
         w = lease.worker
         w.lease_id = None
@@ -435,7 +486,7 @@ class Raylet:
         if not self._feasible(resources):
             return {"ok": False, "error": "infeasible on this node"}
         fut = asyncio.get_running_loop().create_future()
-        self.lease_queue.append(({"resources": resources}, fut))
+        self.lease_queue.append(({"resources": resources}, fut, None))
         self._pump_lease_queue()
         try:
             grant = await asyncio.wait_for(fut, timeout=90)

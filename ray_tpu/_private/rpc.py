@@ -302,12 +302,15 @@ class Server:
     async def stop_async(self):
         if self._server is not None:
             self._server.close()
+        # Close client transports BEFORE wait_closed: since 3.12 asyncio's
+        # Server.wait_closed() blocks until every client connection is gone.
+        for c in list(self.connections):
+            c._do_close()
+        if self._server is not None:
             try:
                 await self._server.wait_closed()
             except Exception:
                 pass
-        for c in list(self.connections):
-            c._do_close()
 
 
 class Client:

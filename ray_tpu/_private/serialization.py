@@ -12,9 +12,22 @@ from __future__ import annotations
 
 import io
 import pickle
+import threading
 from typing import Any, List, Tuple
 
 import cloudpickle
+
+from ray_tpu._private.object_ref import collect_refs
+
+_tls = threading.local()
+
+
+def take_contained_refs() -> List:
+    """ObjectRefs pickled by the most recent serialize() on this thread.
+    Consumed (cleared) by the call."""
+    refs = getattr(_tls, "contained", None)
+    _tls.contained = None
+    return refs or []
 
 # Wire format of a serialized object:
 #   [u32 meta_len][meta pickle][u64 nbuf][u64 len_i ...][buffer bytes ...]
@@ -24,9 +37,14 @@ _PROTOCOL = 5
 
 
 def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
-    """Returns (meta_bytes, out_of_band_buffers)."""
+    """Returns (meta_bytes, out_of_band_buffers). Contained ObjectRefs are
+    captured for the caller via take_contained_refs()."""
     buffers: List[pickle.PickleBuffer] = []
-    meta = cloudpickle.dumps(value, protocol=_PROTOCOL, buffer_callback=buffers.append)
+    with collect_refs() as contained:
+        meta = cloudpickle.dumps(
+            value, protocol=_PROTOCOL, buffer_callback=buffers.append
+        )
+    _tls.contained = contained
     views = [b.raw() for b in buffers]
     return meta, views
 
@@ -71,8 +89,36 @@ def pack_into(meta: bytes, views: List[memoryview], dest: memoryview) -> int:
     return pos
 
 
-def unpack(data) -> Any:
-    """Zero-copy read: `data` may be bytes or a memoryview over shm."""
+class _PinnedSlice:
+    """A buffer-protocol view that keeps a pin object alive.
+
+    Arrays deserialized zero-copy out of the shared-memory store hold their
+    buffer object as ``arr.base``; routing every out-of-band buffer through a
+    _PinnedSlice ties the store's refcount (held by ``pin``) to the lifetime
+    of ALL views — the object cannot be LRU-evicted from under live arrays
+    (parity: reference PlasmaClient buffer pinning, plasma/client.h).
+    """
+
+    __slots__ = ("_view", "_pin")
+
+    def __init__(self, view: memoryview, pin):
+        self._view = view
+        self._pin = pin
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+    def __release_buffer__(self, view):
+        view.release()
+
+
+def unpack(data, pin=None) -> Any:
+    """Zero-copy read: `data` may be bytes or a memoryview over shm.
+
+    ``pin``: optional object whose lifetime must cover every zero-copy view
+    (its finalizer releases the store ref). Only out-of-band buffers are
+    zero-copy; the pickled metadata is always copied.
+    """
     mv = memoryview(data)
     pos = 0
     meta_len = int.from_bytes(mv[pos : pos + 4], "big"); pos += 4
@@ -83,5 +129,7 @@ def unpack(data) -> Any:
         lens.append(int.from_bytes(mv[pos : pos + 8], "big")); pos += 8
     buffers = []
     for n in lens:
-        buffers.append(mv[pos : pos + n]); pos += n
+        b = mv[pos : pos + n]
+        buffers.append(b if pin is None else _PinnedSlice(b, pin))
+        pos += n
     return deserialize(meta, buffers)

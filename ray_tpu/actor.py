@@ -51,23 +51,40 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: bytes, class_name: str = ""):
+    def __init__(self, actor_id: bytes, class_name: str = "",
+                 method_meta: Optional[Dict[str, int]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
+        # method name -> num_returns (from @ray_tpu.method decorators)
+        self._method_meta = method_meta or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        return ActorMethod(self, name, self._method_meta.get(name, 1))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._class_name))
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_meta))
 
     def _actor_hex(self):
         return self._actor_id.hex()
+
+
+def _method_meta_of(cls) -> Dict[str, int]:
+    """num_returns per method, collected from @ray_tpu.method markers."""
+    meta = {}
+    for name in dir(cls):
+        if name.startswith("_"):
+            continue
+        fn = getattr(cls, name, None)
+        n = getattr(fn, "__ray_num_returns__", None)
+        if n is not None:
+            meta[name] = int(n)
+    return meta
 
 
 class ActorClass:
@@ -96,6 +113,7 @@ class ActorClass:
             values.append(_KwArgs(kwargs))
         wire, pinned = cw._encode_args(values)
         opts = self._opts
+        meta = _method_meta_of(self._cls)
         actor_id = cw.create_actor(
             self._cls,
             wire,
@@ -105,5 +123,6 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             pinned=pinned,
+            method_meta=meta,
         )
-        return ActorHandle(actor_id, self._cls.__name__)
+        return ActorHandle(actor_id, self._cls.__name__, meta)
