@@ -109,11 +109,13 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort cancel of the task producing `ref` (not yet interruptive)."""
-    # Round-1: tasks already running are not interrupted; queued tasks will
-    # still run. Kept for API parity; full cancel lands with the scheduler
-    # cancellation protocol.
-    return False
+    """Cancel the task producing ``ref`` (parity: worker.py:2806 +
+    CoreWorker cancellation). Queued/unscheduled tasks are dropped and their
+    returns resolve to TaskCancelledError; a task already running to
+    completion is not interrupted (returns False). ``force``/``recursive``
+    accepted for API parity; interruptive force-kill requires executor
+    preemption, which the single-threaded JAX executor deliberately avoids."""
+    return require_connected().cancel_task(ref)
 
 
 def get_actor(name: str) -> ActorHandle:

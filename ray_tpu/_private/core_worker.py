@@ -139,6 +139,7 @@ class _LeaseState:
         self.queue: collections.deque = collections.deque()
         self.active = 0  # granted leases currently looping
         self.requests_in_flight = 0
+        self.strategy = None  # wire-form scheduling strategy for this key
 
 
 class CoreWorker:
@@ -212,6 +213,7 @@ class CoreWorker:
 
         # task manager (owner side)
         self._pending_tasks: Dict[bytes, Dict] = {}
+        self._cancelled: set = set()  # task_ids cancelled before dispatch
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lineage_pinned: Dict[bytes, List] = {}  # task_id -> arg refs
         self._pull_failures: Dict[ObjectID, int] = collections.defaultdict(int)
@@ -726,8 +728,22 @@ class CoreWorker:
         self.io.submit(self._submit_async(spec))
         return refs
 
+    @staticmethod
+    def _freeze(v):
+        return tuple(CoreWorker._freeze(x) for x in v) if isinstance(
+            v, (list, tuple)
+        ) else v
+
     def _lease_key(self, spec: TaskSpec) -> Tuple:
-        return tuple(sorted((spec.resources or {}).items()))
+        # Leases are multiplexed only across tasks with identical resource
+        # AND strategy requirements (a SPREAD task must not ride an
+        # affinity-placed lease).
+        return (
+            tuple(sorted((spec.resources or {}).items())),
+            self._freeze(spec.scheduling_strategy)
+            if spec.scheduling_strategy is not None
+            else None,
+        )
 
     async def _submit_async(self, spec: TaskSpec):
         try:
@@ -735,10 +751,14 @@ class CoreWorker:
         except Exception as e:
             self._fail_task(spec, e)
             return
+        info = self._pending_tasks.get(spec.task_id)
+        if info is not None:
+            info["state"] = "queued"
         key = self._lease_key(spec)
         st = self._lease_states.get(key)
         if st is None:
             st = self._lease_states[key] = _LeaseState()
+            st.strategy = spec.scheduling_strategy
         st.queue.append(spec)
         self._maybe_request_lease(key, st)
 
@@ -792,7 +812,9 @@ class CoreWorker:
     async def _lease_loop(self, key: Tuple, st: _LeaseState):
         granted = False
         try:
-            resources = dict(key)
+            res_items, _ = key
+            resources = dict(res_items)
+            strategy = st.strategy  # original wire form (key is frozen)
             raylet_conn = self.raylet.conn
             grant = None
             for _hop in range(8):  # bounded spillback chain
@@ -802,7 +824,8 @@ class CoreWorker:
                     # lease would leak the worker (ADVICE r1).
                     reply = await raylet_conn.call_async(
                         "request_worker_lease",
-                        {"resources": resources},
+                        {"resources": resources, "strategy": strategy,
+                         "hops": _hop},
                         timeout=None,
                     )
                 except Exception:
@@ -848,6 +871,15 @@ class CoreWorker:
                 return
             while st.queue:
                 spec = st.queue.popleft()
+                if spec.task_id in self._cancelled:
+                    self._cancelled.discard(spec.task_id)
+                    self._fail_task(spec, exc.TaskCancelledError(
+                        f"task {spec.name} was cancelled before execution"
+                    ))
+                    continue
+                info = self._pending_tasks.get(spec.task_id)
+                if info is not None:
+                    info["state"] = "running"
                 try:
                     reply = await conn.call_async(
                         "push_task", spec.to_wire(), timeout=None
@@ -871,6 +903,7 @@ class CoreWorker:
 
     def _handle_task_reply(self, spec: TaskSpec, reply: Dict, worker_addr):
         returns = reply.get("returns", [])
+        self._cancelled.discard(spec.task_id)  # too late to cancel
         info = self._pending_tasks.get(spec.task_id)
         if reply.get("system_error"):
             e = exc.WorkerCrashedError(reply["system_error"])
@@ -1065,6 +1098,12 @@ class CoreWorker:
             await asyncio.sleep(0.05)
 
     async def _submit_actor_async(self, spec: TaskSpec):
+        if spec.task_id in self._cancelled:
+            self._cancelled.discard(spec.task_id)
+            self._fail_task(spec, exc.TaskCancelledError(
+                f"actor task {spec.name} was cancelled before execution"
+            ))
+            return
         try:
             await self._resolve_dependencies(spec)
         except Exception as e:
@@ -1102,6 +1141,9 @@ class CoreWorker:
                     return
                 await asyncio.sleep(0.2 * attempts)
                 continue
+            info = self._pending_tasks.get(spec.task_id)
+            if info is not None:
+                info["state"] = "running"
             try:
                 reply = await conn.call_async("push_task", spec.to_wire(),
                                               timeout=None)
@@ -1135,6 +1177,41 @@ class CoreWorker:
                 return
             self._handle_task_reply(spec, reply, addr)
             return
+
+    def cancel_task(self, ref: ObjectRef) -> bool:
+        """Cancel the (not-yet-running) task that produces ``ref``."""
+        task_id = ref.id.task_id().binary()
+        info = self._pending_tasks.get(task_id)
+        if info is None:
+            return False  # already finished (or unknown)
+        if info.get("state") == "running":
+            return False  # already dispatched; we don't interrupt execution
+        self._cancelled.add(task_id)
+
+        # If it's still sitting in a lease queue, fail it now; if a push loop
+        # already holds it, the pre-push check (above) fails it instead.
+        def _sweep():
+            for st in self._lease_states.values():
+                for spec in list(st.queue):
+                    if spec.task_id == task_id:
+                        st.queue.remove(spec)
+                        self._cancelled.discard(task_id)
+                        self._fail_task(spec, exc.TaskCancelledError(
+                            f"task {spec.name} was cancelled"
+                        ))
+                        return
+            for q in self._actor_queues.values():
+                for spec in list(q):
+                    if spec.task_id == task_id:
+                        q.remove(spec)
+                        self._cancelled.discard(task_id)
+                        self._fail_task(spec, exc.TaskCancelledError(
+                            f"actor task {spec.name} was cancelled"
+                        ))
+                        return
+
+        self.io.call_soon(_sweep)
+        return True
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
         self.gcs.call("kill_actor", [actor_id, no_restart])

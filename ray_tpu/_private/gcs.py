@@ -239,17 +239,36 @@ class GcsServer:
         asyncio.get_running_loop().create_task(self._place_actor(rec))
         return {"ok": True}
 
-    def _pick_node_for(self, resources: Dict[str, float]) -> Optional[bytes]:
-        """Pack-biased placement using the latest resource view."""
-        best, best_avail = None, -1.0
+    def _pick_node_for(
+        self, resources: Dict[str, float], strategy=None
+    ) -> Optional[bytes]:
+        """Actor placement honoring the scheduling strategy (parity: the
+        reference GcsActorScheduler consults the task's strategy;
+        gcs_actor_scheduler.h:111). Default is pack-biased."""
+        if isinstance(strategy, (list, tuple)) and strategy and (
+            strategy[0] == "affinity"
+        ):
+            target_hex, soft = str(strategy[1]), bool(strategy[2])
+            for nid, info in self.nodes.items():
+                if nid.hex() == target_hex and info.alive:
+                    return nid
+            if not soft:
+                return None  # hard affinity to a gone node: keep waiting
+            # soft: fall through to default
+        spread = strategy == "SPREAD"
+        best, best_score = None, None
         for nid, info in self.nodes.items():
             if not info.alive:
                 continue
             avail = self.node_resources.get(nid, {}).get("available", {})
             if all(avail.get(r, 0.0) >= q for r, q in resources.items()):
                 score = sum(avail.values())
-                if best is None or score < best_avail:
-                    best, best_avail = nid, score
+                better = (
+                    best is None
+                    or (score > best_score if spread else score < best_score)
+                )
+                if better:
+                    best, best_score = nid, score
         if best is None:
             # fall back to any alive node that *totals* enough (queue there)
             for nid, info in self.nodes.items():
@@ -266,7 +285,10 @@ class GcsServer:
         spec = rec.spec
         deadline = time.monotonic() + 60.0
         while rec.state in (PENDING, RESTARTING):
-            node_id = self._pick_node_for(spec.get("resources") or {})
+            node_id = self._pick_node_for(
+                spec.get("resources") or {},
+                strategy=spec.get("scheduling_strategy"),
+            )
             raylet = self._raylet_clients.get(node_id) if node_id else None
             if raylet is None or raylet.closed:
                 if time.monotonic() > deadline:

@@ -165,11 +165,30 @@ class Raylet:
     def _pump_infeasible(self, expire: bool = False):
         """Re-evaluate parked lease requests after cluster topology changes."""
         now = time.monotonic()
+        me = self.node_id.hex()
         remaining = []
         for summary, fut, deadline, conn in self.infeasible_queue:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
+            strategy = summary.get("strategy")
+            if isinstance(strategy, (list, tuple)) and strategy and (
+                strategy[0] == "affinity" and not bool(strategy[2])
+            ):
+                # Hard affinity: ONLY its target node can satisfy this —
+                # default re-dispatch below would grant on the wrong node.
+                target_hex = str(strategy[1])
+                node = self.cluster_nodes.get(target_hex)
+                alive = node is not None and node.get("alive", True)
+                if alive and target_hex == me and self._feasible(resources):
+                    self.lease_queue.append((summary, fut, conn))
+                elif alive and target_hex != me:
+                    fut.set_result({"spillback": node["raylet_addr"]})
+                elif expire and now > deadline:
+                    fut.set_result({"infeasible": True})
+                else:
+                    remaining.append((summary, fut, deadline, conn))
+                continue
             # Local feasibility can change at runtime once placement-group
             # bundle reservation mutates total_resources.
             if self._feasible(resources):
@@ -324,8 +343,63 @@ class Raylet:
         Reply: {"granted": .., "worker": Address wire, "lease_id": ..}
            or  {"spillback": raylet_addr}
            or  {"infeasible": True}
+
+        ``strategy`` (parity: util/scheduling_strategies.py consulted by the
+        reference scheduling policies, hybrid/spread/node-affinity):
+          None/"DEFAULT"          hybrid pack-then-spread (below)
+          "SPREAD"                least-utilized feasible node
+          ["affinity", hex, soft] pin to one node (soft falls back)
+        ``hops`` > 0 marks a spilled-back request: grant locally if feasible
+        rather than re-spilling (prevents ping-pong between disagreeing
+        resource views).
         """
         resources = summary.get("resources") or {}
+        strategy = summary.get("strategy")
+        hops = int(summary.get("hops") or 0)
+        me = self.node_id.hex()
+
+        if isinstance(strategy, (list, tuple)) and strategy and strategy[0] == "affinity":
+            target_hex, soft = str(strategy[1]), bool(strategy[2])
+            target = self.cluster_nodes.get(target_hex)
+            alive = target is not None and target.get("alive", True)
+            if target_hex != me:
+                if alive:
+                    return {"spillback": target["raylet_addr"]}
+                if not soft:
+                    # Hard affinity to a missing node: park (it may rejoin),
+                    # expire to an explicit infeasible error.
+                    fut = asyncio.get_running_loop().create_future()
+                    grace = GLOBAL_CONFIG.infeasible_task_grace_s
+                    self.infeasible_queue.append(
+                        (summary, fut, time.monotonic() + grace, conn)
+                    )
+                    self._watch_owner(conn)
+                    return await fut
+                # soft: fall through to default placement
+            else:
+                if self._feasible(resources):
+                    fut = asyncio.get_running_loop().create_future()
+                    self.lease_queue.append((summary, fut, conn))
+                    self._watch_owner(conn)
+                    self._pump_lease_queue()
+                    return await fut
+                if not soft:
+                    fut = asyncio.get_running_loop().create_future()
+                    grace = GLOBAL_CONFIG.infeasible_task_grace_s
+                    self.infeasible_queue.append(
+                        (summary, fut, time.monotonic() + grace, conn)
+                    )
+                    self._watch_owner(conn)
+                    return await fut
+                # soft: fall through
+
+        if strategy == "SPREAD" and hops == 0:
+            target = self._pick_spread_target(resources)
+            if target is not None and target != me:
+                node = self.cluster_nodes.get(target)
+                if node and node.get("alive", True):
+                    return {"spillback": node["raylet_addr"]}
+
         if not self._feasible(resources):
             target = self._pick_spillback(resources, strict=True)
             if target:
@@ -338,7 +412,7 @@ class Raylet:
             )
             self._watch_owner(conn)
             return await fut
-        if not self._can_fit_with_queue(resources):
+        if hops == 0 and not self._can_fit_with_queue(resources):
             # Local node is (or will be, counting queued demand) saturated:
             # prefer an idle peer (hybrid pack-then-spread policy, parity:
             # reference hybrid_scheduling_policy.h:50).
@@ -406,6 +480,28 @@ class Raylet:
             if all(pool.get(r, 0.0) >= q for r, q in resources.items()):
                 return node["raylet_addr"]
         return None
+
+    def _pick_spread_target(self, resources: Dict) -> Optional[str]:
+        """Least-utilized node (by fraction of CPU available) that can fit
+        the request now — parity: reference spread_scheduling_policy.h:27."""
+        best, best_score = None, -1.0
+        for nid_hex, node in self.cluster_nodes.items():
+            if not node.get("alive", True):
+                continue
+            if nid_hex == self.node_id.hex():
+                avail, total = self.available, self.total_resources
+            else:
+                view = self.cluster_resources.get(nid_hex)
+                if view is None:
+                    continue
+                avail, total = view.get("available", {}), view.get("total", {})
+            if not all(avail.get(r, 0.0) >= q for r, q in resources.items()):
+                continue
+            cap = total.get("CPU", 0.0)
+            score = (avail.get("CPU", 0.0) / cap) if cap else 0.0
+            if score > best_score:
+                best, best_score = nid_hex, score
+        return best
 
     def _pump_lease_queue(self):
         if self._stopping:

@@ -11,7 +11,11 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private.core_worker import _KwArgs
 from ray_tpu._private.worker import require_connected
-from ray_tpu.remote_function import _normalize_opts, _resources_from
+from ray_tpu.remote_function import (
+    _encode_strategy,
+    _normalize_opts,
+    _resources_from,
+)
 
 
 class ActorMethod:
@@ -122,6 +126,9 @@ class ActorClass:
             resources=_resources_from(opts),
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            scheduling_strategy=_encode_strategy(
+                opts.get("scheduling_strategy")
+            ),
             pinned=pinned,
             method_meta=meta,
         )

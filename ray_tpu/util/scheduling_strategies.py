@@ -1,0 +1,65 @@
+"""Scheduling strategy API.
+
+Parity: reference ``python/ray/util/scheduling_strategies.py`` —
+``PlacementGroupSchedulingStrategy:15``, ``NodeAffinitySchedulingStrategy:41``;
+string strategies "DEFAULT" and "SPREAD". Strategies are consulted by the
+raylet lease path and the GCS actor scheduler (unlike round 1, where the
+parameter was plumbed but dead).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+DEFAULT = "DEFAULT"
+SPREAD = "SPREAD"
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin a task/actor to one node.
+
+    ``soft=False``: run there or fail (after the infeasible grace window if
+    the node is gone). ``soft=True``: prefer that node, fall back to default
+    placement when it's unavailable or saturated.
+    """
+
+    def __init__(self, node_id: str, soft: bool = False):
+        if isinstance(node_id, bytes):
+            node_id = node_id.hex()
+        self.node_id = node_id
+        self.soft = bool(soft)
+
+    def to_wire(self):
+        return ["affinity", self.node_id, self.soft]
+
+    def __repr__(self):
+        return (f"NodeAffinitySchedulingStrategy({self.node_id[:12]}, "
+                f"soft={self.soft})")
+
+
+class PlacementGroupSchedulingStrategy:
+    """Run inside a placement group's reserved bundle(s).
+
+    ``placement_group_bundle_index=-1`` means any bundle of the group.
+    """
+
+    def __init__(
+        self,
+        placement_group,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks
+        )
+
+    def to_wire(self):
+        pg_id = getattr(self.placement_group, "id", self.placement_group)
+        if isinstance(pg_id, bytes):
+            pg_id = pg_id.hex()
+        return ["pg", pg_id, self.placement_group_bundle_index]
+
+    def __repr__(self):
+        return f"PlacementGroupSchedulingStrategy({self.to_wire()[1][:12]})"

@@ -263,3 +263,63 @@ def test_object_lost_without_lineage(cluster2):
         (ray_tpu.exceptions.ObjectLostError, ray_tpu.exceptions.GetTimeoutError)
     ):
         ray_tpu.get(inner_ref, timeout=30)
+
+
+def test_node_affinity_strategy(cluster2):
+    """NodeAffinitySchedulingStrategy pins tasks and actors to one node
+    (parity: scheduling_strategies.py:41 — live, not a dead parameter)."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    other_hex = next(
+        n.node_id.hex() for n in cluster2._impl.nodes.values()
+        if n is not cluster2.head_node
+    )
+    strat = NodeAffinitySchedulingStrategy(other_hex)
+    out = ray_tpu.get(
+        where.options(scheduling_strategy=strat, num_cpus=1).remote(),
+        timeout=60,
+    )
+    assert out == other_hex
+
+    @ray_tpu.remote(num_cpus=1)
+    class Where:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Where.options(scheduling_strategy=strat).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == other_hex
+
+
+def test_spread_strategy(cluster2):
+    """SPREAD tasks land on both nodes even when the head has room."""
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def spread_where():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([spread_where.remote() for _ in range(4)],
+                            timeout=120))
+    assert len(nodes) == 2, f"SPREAD used one node: {nodes}"
+
+
+def test_cancel_queued_task(cluster2):
+    """ray_tpu.cancel drops a queued task; its ref raises TaskCancelledError."""
+
+    @ray_tpu.remote(num_cpus=2, resources={"head": 1})
+    def blocker():
+        time.sleep(5)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=2, resources={"head": 1})
+    def victim():
+        return "ran"
+
+    b = blocker.remote()          # occupies the only head slot
+    time.sleep(0.5)
+    v = victim.remote()           # queued behind it
+    assert ray_tpu.cancel(v) is True
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(v, timeout=60)
+    assert ray_tpu.get(b, timeout=60) == "done"
+    assert ray_tpu.cancel(b) is False  # already finished
