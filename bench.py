@@ -50,57 +50,73 @@ def main():
         make_train_step,
     )
 
+    import dataclasses
+
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
-    if on_accel:
-        cfg = TransformerConfig.bench_400m()
-        batch, seq, iters = 8, 2048, 10
-    else:
-        cfg = TransformerConfig.tiny()
-        batch, seq, iters = 4, 128, 3
-
     mesh = build_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
     opt = default_optimizer()
-    state, state_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
-    step = make_train_step(cfg, mesh, opt, state_sh)
+    peak = peak_flops(dev)
 
-    data_sh = batch_sharding(mesh)
-    tokens = jax.device_put(
-        jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size),
-        data_sh,
-    ).astype(jnp.int32)
-    b = {
-        "tokens": tokens,
-        "targets": tokens,
-        "mask": jax.device_put(jnp.ones((batch, seq), jnp.float32), data_sh),
-    }
+    def measure(cfg, batch, seq, iters):
+        state, state_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
+        step = make_train_step(cfg, mesh, opt, state_sh)
+        data_sh = batch_sharding(mesh)
+        tokens = jax.device_put(
+            jax.random.randint(
+                jax.random.key(1), (batch, seq), 0, cfg.vocab_size
+            ),
+            data_sh,
+        ).astype(jnp.int32)
+        b = {
+            "tokens": tokens,
+            "targets": tokens,
+            "mask": jax.device_put(jnp.ones((batch, seq), jnp.float32), data_sh),
+        }
+        state, m = step(state, b)  # compile + warmup
+        float(m["loss"])  # host fetch: block_until_ready alone does not sync
+        t0 = time.perf_counter()  # through the remote-TPU tunnel
+        for _ in range(iters):
+            state, m = step(state, b)
+        float(m["loss"])  # forces the whole chain
+        dt = (time.perf_counter() - t0) / iters
+        tokens_per_step = batch * seq
+        flops = 6 * cfg.param_count() * tokens_per_step + (
+            12 * cfg.n_layers * cfg.n_heads * cfg.d_head * batch * seq * seq // 2
+        )
+        return dt, flops / dt / peak, tokens_per_step / dt
 
-    state, m = step(state, b)  # compile + warmup
-    float(m["loss"])  # host fetch: block_until_ready alone does not sync
-    t0 = time.perf_counter()  # through the remote-TPU tunnel
-    for _ in range(iters):
-        state, m = step(state, b)
-    float(m["loss"])  # forces the whole chain
-    dt = (time.perf_counter() - t0) / iters
+    if on_accel:
+        cfg = TransformerConfig.bench_400m()
+        dt, mfu, tps = measure(cfg, batch=8, seq=2048, iters=10)
+        # Long-context entry: same model, seq 8192, Pallas flash attention.
+        lc_cfg = dataclasses.replace(cfg, max_seq_len=8192)
+        lc_dt, lc_mfu, lc_tps = measure(lc_cfg, batch=2, seq=8192, iters=8)
+        long_ctx = {
+            "metric": "train_step_mfu_400m_seq8192",
+            "value": round(lc_mfu, 4),
+            "step_ms": round(lc_dt * 1e3, 2),
+            "tokens_per_s": round(lc_tps, 1),
+        }
+        metric = "train_step_mfu_400m"
+    else:
+        cfg = TransformerConfig.tiny()
+        dt, mfu, tps = measure(cfg, batch=4, seq=128, iters=3)
+        long_ctx = None
+        metric = "train_step_mfu_tiny_cpu"
 
-    n_params = cfg.param_count()
-    tokens_per_step = batch * seq
-    dense_flops = 6 * n_params * tokens_per_step
-    attn_flops = (
-        12 * cfg.n_layers * cfg.n_heads * cfg.d_head * batch * seq * seq // 2
-    )
-    flops = dense_flops + attn_flops
-    mfu = flops / dt / peak_flops(dev)
     out = {
-        "metric": "train_step_mfu_400m" if on_accel else "train_step_mfu_tiny_cpu",
+        "metric": metric,
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.40, 4),
         "detail": {
             "device": getattr(dev, "device_kind", dev.platform),
-            "params": n_params,
+            "params": cfg.param_count(),
             "step_ms": round(dt * 1e3, 2),
-            "tokens_per_s": round(tokens_per_step / dt, 1),
+            "tokens_per_s": round(tps, 1),
+            "attn_impl": cfg.attn_impl,
+            "long_ctx": long_ctx,
         },
     }
     print(json.dumps(out))

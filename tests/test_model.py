@@ -150,3 +150,81 @@ def test_dryrun_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_flash_attention_matches_dense():
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 2, 128, 2, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    dense = causal_attention(q, k, v)
+    flash = flash_attention(q, k, v, block_q=64, block_kv=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+
+
+def test_flash_attention_grads_match_dense():
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 1, 128, 2, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (b, s, h, d), jnp.float32)
+
+    def loss(attn):
+        def f(q, k, v):
+            return (attn(q, k, v) * w).sum()
+        return f
+
+    gf = jax.grad(
+        loss(lambda q, k, v: flash_attention(
+            q, k, v, block_q=64, block_kv=64, interpret=True)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        loss(lambda q, k, v: causal_attention(q, k, v)), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)
+
+
+def test_flash_attention_gqa():
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, hkv, d = 1, 128, 4, 2, 32
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, d))
+    dense = causal_attention(q, k, v)
+    flash = flash_attention(q, k, v, block_q=64, block_kv=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+
+
+def test_flash_attention_sharded_under_mesh():
+    from ray_tpu.ops.flash_attention import flash_attention_sharded
+
+    mesh = build_mesh(MeshConfig(dp=4, sp=1, tp=2))
+    b, s, h, d = 4, 128, 2, 32
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    dense = causal_attention(q, k, v)
+    flash = flash_attention_sharded(
+        q, k, v, mesh=mesh, block_q=64, block_kv=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+
+
+def test_flash_transformer_forward_matches_dense():
+    cfg = TransformerConfig.tiny(max_seq_len=128)
+    cfg_f = dataclasses.replace(cfg, attn_impl="flash")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 128), 0, cfg.vocab_size)
+    ld = forward(params, tokens, cfg)
+    lf = forward(params, tokens, cfg_f)
+    np.testing.assert_allclose(
+        np.asarray(ld, np.float32), np.asarray(lf, np.float32),
+        atol=5e-2, rtol=1e-2,
+    )
