@@ -1,0 +1,48 @@
+"""ray_tpu.train — the Train-equivalent library (SPMD over a global mesh).
+
+Public surface (parity: ``ray.train`` / ``ray.air``):
+
+    from ray_tpu import train
+    from ray_tpu.train import (
+        JaxTrainer, ScalingConfig, RunConfig, CheckpointConfig,
+        FailureConfig, Checkpoint, Result, session,
+    )
+
+    def loop(config):
+        mesh = train.session.make_mesh()
+        ...
+        train.session.report({"loss": l}, checkpoint=...)
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4)
+    ).fit()
+"""
+
+from ray_tpu.train import session  # noqa: F401
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import (  # noqa: F401
+    DataParallelTrainer,
+    JaxTrainer,
+    TrainingFailedError,
+)
+
+__all__ = [
+    "JaxTrainer",
+    "DataParallelTrainer",
+    "ScalingConfig",
+    "RunConfig",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Checkpoint",
+    "CheckpointManager",
+    "Result",
+    "TrainingFailedError",
+    "session",
+]

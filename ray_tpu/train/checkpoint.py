@@ -1,0 +1,155 @@
+"""Checkpoint object + driver-side keep-N manager.
+
+Parity: reference ``python/ray/air/checkpoint.py:66`` (morphable
+dict/directory Checkpoint) and ``air/_internal/checkpoint_manager.py:251``
+(scored keep-N registry). TPU shape: checkpoint payloads are host pytrees
+(numpy arrays pulled off device with ``jax.device_get``); they travel from
+worker to driver through the object plane, and persist as a directory of
+``data.pkl`` + ``meta.json`` under the run's storage path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.train.config import CheckpointConfig
+
+
+class Checkpoint:
+    """A morphable checkpoint: dict-backed in flight, directory-backed at
+    rest. ``from_dict``/``to_dict`` for in-memory use (worker->driver),
+    ``from_directory``/``to_directory`` for persisted use."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("exactly one of data/path required")
+        self._data = data
+        self._path = path
+
+    # -- constructors --
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise FileNotFoundError(path)
+        return cls(path=path)
+
+    # -- accessors --
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        with open(os.path.join(self._path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(self._path) != os.path.abspath(path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, "data.pkl"), "wb") as f:
+                pickle.dump(self._data, f, protocol=5)
+        return path
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def __repr__(self):
+        src = self._path if self._path else f"<dict:{len(self._data)} keys>"
+        return f"Checkpoint({src})"
+
+
+class CheckpointManager:
+    """Driver-side registry: persists reported checkpoints under
+    ``<storage>/checkpoint_<index>``, scores them, deletes beyond
+    ``num_to_keep``, and exposes latest/best for resume."""
+
+    def __init__(self, storage_path: str, config: CheckpointConfig):
+        self.storage_path = storage_path
+        self.config = config
+        self._entries: List[Tuple[str, float, Dict]] = []  # (dir, score, metrics)
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+        self._load_existing()
+
+    def _load_existing(self):
+        idx_file = os.path.join(self.storage_path, "checkpoints.json")
+        if os.path.exists(idx_file):
+            with open(idx_file) as f:
+                saved = json.load(f)
+            self._entries = [
+                (e["dir"], e["score"], e["metrics"])
+                for e in saved["entries"]
+                if os.path.isdir(e["dir"])
+            ]
+            self._index = saved.get("index", len(self._entries))
+
+    def _save_index(self):
+        idx_file = os.path.join(self.storage_path, "checkpoints.json")
+        with open(idx_file, "w") as f:
+            json.dump(
+                {
+                    "index": self._index,
+                    "entries": [
+                        {"dir": d, "score": s, "metrics": m}
+                        for d, s, m in self._entries
+                    ],
+                },
+                f,
+            )
+
+    def _score(self, metrics: Dict) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None or attr not in metrics:
+            # Missing score attribute falls back to recency (reference Train
+            # warns rather than failing the run on a bad report).
+            return time.time()
+        val = float(metrics[attr])
+        return val if self.config.checkpoint_score_order == "max" else -val
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict) -> Checkpoint:
+        """Persist + score a reported checkpoint; returns the dir-backed one."""
+        path = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        self._index += 1
+        checkpoint.to_directory(path)
+        clean = {k: v for k, v in metrics.items()
+                 if isinstance(v, (int, float, str, bool))}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(clean, f)
+        self._entries.append((path, self._score(metrics), clean))
+        keep = self.config.num_to_keep
+        if keep is not None:
+            while len(self._entries) > keep:
+                # evict the lowest-scored (latest always survives)
+                victim = min(self._entries[:-1], key=lambda e: e[1])
+                self._entries.remove(victim)
+                shutil.rmtree(victim[0], ignore_errors=True)
+        self._save_index()
+        return Checkpoint.from_directory(path)
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint.from_directory(self._entries[-1][0])
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        best = max(self._entries, key=lambda e: e[1])
+        return Checkpoint.from_directory(best[0])
+
+    @property
+    def num_checkpoints(self) -> int:
+        return len(self._entries)

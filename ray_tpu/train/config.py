@@ -1,0 +1,90 @@
+"""Train-equivalent configuration dataclasses.
+
+Parity: reference ``python/ray/air/config.py`` (ScalingConfig:91,
+RunConfig:705, CheckpointConfig:575, FailureConfig:524) — reshaped for TPU:
+the unit of scaling is a *host process that owns local chips and joins one
+global device mesh*, not a fungible GPU worker, so ScalingConfig carries a
+``MeshConfig`` describing how the assembled global device set is factored
+into dp/pp/ep/sp/tp axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many host workers to gang-start and how they mesh together.
+
+    num_workers: host processes (one per TPU VM in a pod). Each runs
+        ``jax.distributed.initialize`` and owns its node-local chips.
+    use_tpu: request the ``TPU`` resource (workers get the TPU runtime env).
+    resources_per_worker: extra scheduler resources per worker.
+    mesh: factorization of the global device set; ``None`` = pure DP.
+    devices_per_worker: virtual-device override for CPU-simulated tests
+        (sets ``jax_num_cpu_devices`` in each worker before jax init).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh: Optional[MeshConfig] = None
+    devices_per_worker: Optional[int] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-N / scoring policy for persisted checkpoints
+    (parity: air/config.py:575)."""
+
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_score_attribute: Optional[str] = None  # None = recency
+    checkpoint_score_order: str = "max"  # max | min
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Trainer-level fault tolerance (parity: air/config.py:524).
+
+    max_failures: group restarts (from latest checkpoint) before giving up;
+    -1 = unlimited.
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # default: ~/ray_tpu_results
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig
+    )
+
+
+@dataclasses.dataclass
+class Result:
+    """What ``JaxTrainer.fit`` returns (parity: air Result)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821 (train.checkpoint)
+    path: Optional[str] = None
+    error: Optional[BaseException] = None

@@ -1,0 +1,128 @@
+"""Worker-side training session.
+
+Parity: reference ``python/ray/train/_internal/session.py:84`` — the user's
+``train_loop_per_worker`` runs on a thread inside the TrainWorker actor; each
+``session.report(metrics, checkpoint=...)`` enqueues an event that the driver
+drains via the actor's ``poll()`` method. TPU additions: the session owns the
+global-mesh handshake (``jax.distributed`` world info) and a
+``distribute_batch`` helper that turns per-host numpy batches into globally
+sharded ``jax.Array``s (the multihost data-loading idiom).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    experiment_name: str
+    mesh_config: Optional[Any] = None  # parallel.MeshConfig
+
+
+class _TrainSession:
+    """One per training attempt inside a TrainWorker."""
+
+    def __init__(self, context: TrainContext,
+                 checkpoint: Optional[Checkpoint]):
+        self.context = context
+        self.start_checkpoint = checkpoint
+        self.events: "queue.Queue[Dict]" = queue.Queue()
+        self.iteration = 0
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        # Only rank 0's checkpoint is persisted by the driver; shipping the
+        # other ranks' identical payloads through the object plane would be
+        # pure waste, so drop them at the source.
+        ship_ckpt = checkpoint if self.context.world_rank == 0 else None
+        self.events.put(
+            {
+                "type": "report",
+                "iteration": self.iteration,
+                "metrics": dict(metrics),
+                "checkpoint": ship_ckpt.to_dict() if ship_ckpt else None,
+            }
+        )
+
+
+_session_lock = threading.Lock()
+_session: Optional[_TrainSession] = None
+
+
+def _set_session(s: Optional[_TrainSession]):
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — session.* APIs are only valid "
+            "inside train_loop_per_worker"
+        )
+    return _session
+
+
+# -- public worker-side API (parity: ray.train.session / ray.air.session) --
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get_session().start_checkpoint
+
+
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def get_world_rank() -> int:
+    return _get_session().context.world_rank
+
+
+def get_world_size() -> int:
+    return _get_session().context.world_size
+
+
+def make_mesh(mesh_config=None):
+    """Build the global device mesh this worker participates in.
+
+    Call after the worker group's ``jax.distributed`` bootstrap: sees every
+    process's devices, factored per the ScalingConfig's MeshConfig.
+    """
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = mesh_config or _get_session().context.mesh_config or MeshConfig()
+    return build_mesh(cfg)
+
+
+def distribute_batch(batch, mesh, spec=None):
+    """Per-host numpy batch -> globally sharded jax.Array over ``mesh``.
+
+    Each worker passes only its local slice of the global batch; the result
+    is a global array whose addressable shards are this host's. Spec defaults
+    to batch-over-(dp, ep) like ``parallel.train_step.batch_sharding``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if spec is None:
+        spec = P(("dp", "ep"))
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

@@ -1,0 +1,249 @@
+"""Train-equivalent tests: gang-started SPMD worker groups on CPU devices.
+
+Parity targets: reference ``train/tests/test_data_parallel_trainer.py``-style
+coverage — MNIST-shaped DP across 4 workers (BASELINE config: "MNIST DP 4
+workers"), session.report flow, checkpoint keep-N, restart-from-checkpoint.
+Workers are real processes; ``jax.distributed`` assembles one global CPU
+device world per group (the TPU-pod bootstrap, simulated).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+
+
+@pytest.fixture
+def rt_train():
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _make_mnist_dp_loop():
+    """Nested def => cloudpickled by value (test modules are not importable
+    from worker processes)."""
+
+    def _mnist_dp_loop(config):
+        """Synthetic MNIST-shaped classifier, DP over the global mesh."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.parallel.mesh import MeshConfig
+        from ray_tpu.train import Checkpoint, session
+
+        mesh = session.make_mesh(MeshConfig(dp=-1))
+        rank = session.get_world_rank()
+        assert jax.device_count() == config["expect_devices"], (
+            jax.device_count()
+        )
+
+        # teacher-labeled synthetic 8x8 digits; each worker holds its own shard
+        rng = np.random.RandomState(100 + rank)
+        teacher = np.random.RandomState(0).randn(64, 10).astype(np.float32)
+        x_local = rng.randn(32, 64).astype(np.float32)
+        y_local = (x_local @ teacher).argmax(-1).astype(np.int32)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+
+        def init():
+            k1, k2 = jax.random.split(jax.random.key(0))
+            return {
+                "w1": jax.random.normal(k1, (64, 32)) * 0.1,
+                "b1": jnp.zeros((32,)),
+                "w2": jax.random.normal(k2, (32, 10)) * 0.1,
+            }
+
+        params = jax.jit(init, out_shardings=repl)()
+        opt = optax.adam(1e-2)
+        opt_state = jax.jit(opt.init, out_shardings=repl)(params)
+
+        def loss_fn(p, batch):
+            h = jax.nn.relu(batch["x"] @ p["w1"] + p["b1"])
+            logits = h @ p["w2"]
+            logp = jax.nn.log_softmax(logits)
+            onehot = jax.nn.one_hot(batch["y"], 10)
+            return -(onehot * logp).sum(-1).mean()
+
+        @jax.jit
+        def step(p, o, batch):
+            l, g = jax.value_and_grad(loss_fn)(p, batch)
+            updates, o = opt.update(g, o)
+            return optax.apply_updates(p, updates), o, l
+
+        start = session.get_checkpoint()
+        first_step = 0 if start is None else start.to_dict()["step"] + 1
+
+        losses = []
+        for i in range(first_step, first_step + config["steps"]):
+            batch = session.distribute_batch({"x": x_local, "y": y_local}, mesh)
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+            ckpt = Checkpoint.from_dict(
+                {"step": i, "params": jax.device_get(params)}
+            )
+            session.report({"loss": losses[-1], "step": i}, checkpoint=ckpt)
+        assert losses[-1] < losses[0]
+
+    return _mnist_dp_loop
+
+
+def test_mnist_dp_4_workers(rt_train, tmp_path):
+    """BASELINE 'MNIST DP 4 workers': 4 procs x 2 CPU devices = 8-dev mesh."""
+    trainer = JaxTrainer(
+        _make_mnist_dp_loop(),
+        train_loop_config={"steps": 8, "expect_devices": 8},
+        scaling_config=ScalingConfig(num_workers=4, devices_per_worker=2),
+        run_config=RunConfig(
+            name="mnist_dp", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 7
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["step"] == 7
+    # keep-N enforced on disk
+    assert trainer._ckpt_manager.num_checkpoints == 2
+
+
+def test_single_worker_report_and_resume(rt_train, tmp_path):
+    def loop(config):
+        from ray_tpu.train import Checkpoint, session
+
+        start = session.get_checkpoint()
+        base = 0 if start is None else start.to_dict()["i"] + 1
+        for i in range(base, base + 3):
+            session.report(
+                {"i": i}, checkpoint=Checkpoint.from_dict({"i": i})
+            )
+
+    run = RunConfig(name="resume", storage_path=str(tmp_path))
+    r1 = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                    run_config=run).fit()
+    assert r1.metrics["i"] == 2
+    # A second fit in the same experiment dir resumes from the checkpoint.
+    r2 = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                    run_config=run).fit()
+    assert r2.metrics["i"] == 5
+
+
+def test_failure_restart_from_checkpoint(rt_train, tmp_path):
+    def flaky_loop(config):
+        from ray_tpu.train import Checkpoint, session
+
+        start = session.get_checkpoint()
+        if start is None:
+            # first attempt: checkpoint progress, then die
+            session.report(
+                {"i": 0}, checkpoint=Checkpoint.from_dict({"i": 0})
+            )
+            raise RuntimeError("simulated worker failure")
+        i = start.to_dict()["i"]
+        session.report({"i": i + 1, "resumed": True},
+                       checkpoint=Checkpoint.from_dict({"i": i + 1}))
+
+    result = JaxTrainer(
+        flaky_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="flaky", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.metrics["resumed"] is True
+    assert result.metrics["i"] == 1
+
+
+def test_failure_exhausted_raises(rt_train, tmp_path):
+    def bad_loop(config):
+        raise ValueError("always broken")
+
+    with pytest.raises(TrainingFailedError, match="always broken"):
+        JaxTrainer(
+            bad_loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="bad", storage_path=str(tmp_path)),
+        ).fit()
+
+
+def test_checkpoint_manager_keep_n_scoring(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path),
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc"),
+    )
+    for i, acc in enumerate([0.1, 0.9, 0.5, 0.2]):
+        mgr.register(Checkpoint.from_dict({"i": i}), {"acc": acc})
+    assert mgr.num_checkpoints == 2
+    assert mgr.best_checkpoint.to_dict()["i"] == 1  # acc=0.9 survived
+    assert mgr.latest_checkpoint.to_dict()["i"] == 3  # latest always kept
+
+
+def test_flagship_transformer_via_trainer(rt_train, tmp_path):
+    """The flagship sharded-transformer train step driven through JaxTrainer:
+    2 host workers x 4 CPU devices = 8-device global mesh, dp=2/sp=2/tp=2
+    with ring attention — the GPT-J-path wiring on simulated hardware."""
+
+    def loop(config):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import TransformerConfig
+        from ray_tpu.parallel.mesh import MeshConfig
+        from ray_tpu.parallel.train_step import (
+            batch_sharding,
+            default_optimizer,
+            make_sharded_state,
+            make_train_step,
+        )
+        from ray_tpu.train import Checkpoint, session
+
+        mesh = session.make_mesh(MeshConfig(dp=2, sp=2, tp=2))
+        cfg = TransformerConfig.tiny(max_seq_len=32)
+        cfg = dataclasses.replace(cfg, attn_impl="ring")
+        opt = default_optimizer(lr=1e-2)
+        state, state_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
+        step = make_train_step(cfg, mesh, opt, state_sh)
+
+        import numpy as np
+
+        rank = session.get_world_rank()
+        rng = np.random.RandomState(rank)
+        # global batch 4 -> each of the 2 hosts contributes 2 rows
+        local = {
+            "tokens": rng.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32),
+            "targets": rng.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32),
+            "mask": np.ones((2, 32), np.float32),
+        }
+        losses = []
+        for i in range(3):
+            batch = session.distribute_batch(
+                local, mesh, spec=batch_sharding(mesh).spec
+            )
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+            session.report({"loss": losses[-1], "step": i})
+        assert losses[-1] < losses[0]
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=4),
+        run_config=RunConfig(name="flagship", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.metrics["step"] == 2
