@@ -32,6 +32,36 @@ ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
+# Placement-group states (parity: rpc::PlacementGroupTableData)
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_RESCHEDULING = "RESCHEDULING"
+PG_REMOVED = "REMOVED"
+
+
+class PgRecord:
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "assignment")
+
+    def __init__(self, pg_id: bytes, bundles: List[Dict], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles  # list of resource dicts
+        self.strategy = strategy  # PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+        self.name = name
+        self.state = PG_PENDING
+        # node_id (bytes) per bundle; None = not placed
+        self.assignment: List[Optional[bytes]] = [None] * len(bundles)
+
+    def to_wire(self):
+        return {
+            "pg_id": self.pg_id,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "name": self.name,
+            "state": self.state,
+            "assignment": self.assignment,
+        }
+
 
 class ActorRecord:
     __slots__ = (
@@ -73,6 +103,7 @@ class GcsServer:
         self.node_resources: Dict[bytes, Dict] = {}  # available/total per node
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[str, bytes] = {}
+        self.placement_groups: Dict[bytes, PgRecord] = {}
         self.jobs: Dict[bytes, Dict] = {}
         # pubsub: channel -> set of connections
         self.subs: Dict[str, Set[rpc.Connection]] = {}
@@ -197,6 +228,16 @@ class GcsServer:
                     self.kv[key] = rpc.msgpack.packb(locs)
                 else:
                     self.kv.pop(key, None)
+        # Placement groups lose the dead node's bundles -> reschedule them.
+        for pg in self.placement_groups.values():
+            lost = [i for i, n in enumerate(pg.assignment) if n == node_id]
+            if lost and pg.state in (PG_CREATED, PG_PENDING, PG_RESCHEDULING):
+                for i in lost:
+                    pg.assignment[i] = None
+                if pg.state == PG_CREATED:
+                    pg.state = PG_RESCHEDULING
+                    self._publish("placement_groups", [pg.to_wire()])
+                    asyncio.get_running_loop().create_task(self._place_pg(pg))
         # Actors on that node die (and maybe restart elsewhere).
         for rec in list(self.actors.values()):
             if rec.address and rec.address[2] == node_id and rec.state in (
@@ -245,6 +286,28 @@ class GcsServer:
         """Actor placement honoring the scheduling strategy (parity: the
         reference GcsActorScheduler consults the task's strategy;
         gcs_actor_scheduler.h:111). Default is pack-biased."""
+        from ray_tpu._private.protocol import parse_pg_strategy
+
+        parsed = parse_pg_strategy(strategy)
+        if parsed is not None:
+            pg_id, idx = parsed
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state != PG_CREATED:
+                return None  # keep waiting; _place_actor retries
+            cands = (
+                [pg.assignment[idx]] if 0 <= idx < len(pg.assignment)
+                else [n for n in pg.assignment if n is not None]
+            )
+            alive = [
+                nid for nid in cands
+                if nid is not None and nid in self.nodes
+                and self.nodes[nid].alive
+            ]
+            # Randomize so a full bundle's node is not retried exclusively
+            # while another bundle (idx=-1) has free capacity.
+            import random
+
+            return random.choice(alive) if alive else None
         if isinstance(strategy, (list, tuple)) and strategy and (
             strategy[0] == "affinity"
         ):
@@ -403,6 +466,200 @@ class GcsServer:
 
     async def rpc_list_actors(self, conn, _):
         return [a.to_wire() for a in self.actors.values()]
+
+    # ---------------- placement groups ----------------
+    # Parity: reference GcsPlacementGroupManager/Scheduler 2PC bundle
+    # reservation (gcs_placement_group_scheduler.h:275): plan bundle->node,
+    # PREPARE on every involved raylet (atomic per node), COMMIT only if all
+    # prepared, CANCEL otherwise and retry. A TPU slice is gang-scheduled
+    # exactly this way (SURVEY hard part #3).
+
+    async def rpc_create_placement_group(self, conn, spec: Dict):
+        pg_id = spec["pg_id"]
+        rec = PgRecord(
+            pg_id,
+            [dict(b) for b in spec["bundles"]],
+            spec.get("strategy") or "PACK",
+            name=spec.get("name") or "",
+        )
+        if rec.strategy not in ("PACK", "SPREAD", "STRICT_PACK",
+                                "STRICT_SPREAD"):
+            return {"ok": False, "error": f"bad strategy {rec.strategy!r}"}
+        self.placement_groups[pg_id] = rec
+        asyncio.get_running_loop().create_task(self._place_pg(rec))
+        return {"ok": True}
+
+    async def rpc_get_placement_group(self, conn, pg_id: bytes):
+        rec = self.placement_groups.get(pg_id)
+        return rec.to_wire() if rec else None
+
+    async def rpc_placement_group_table(self, conn, _):
+        return {
+            pid.hex(): rec.to_wire()
+            for pid, rec in self.placement_groups.items()
+        }
+
+    async def rpc_remove_placement_group(self, conn, pg_id: bytes):
+        rec = self.placement_groups.get(pg_id)
+        if rec is None:
+            return False
+        rec.state = PG_REMOVED
+        nodes = {n for n in rec.assignment if n is not None}
+        rec.assignment = [None] * len(rec.bundles)
+        for nid in nodes:
+            raylet = self._raylet_clients.get(nid)
+            if raylet is not None and not raylet.closed:
+                try:
+                    await raylet.call_async("release_bundles", pg_id,
+                                            timeout=10)
+                except Exception:
+                    pass
+        self._publish("placement_groups", [rec.to_wire()])
+        return True
+
+    def _plan_bundles(self, rec: PgRecord) -> Optional[List[bytes]]:
+        """Advisory bundle->node plan from the latest resource view; the
+        authoritative admission check is each raylet's PREPARE."""
+        free: Dict[bytes, Dict[str, float]] = {}
+        for nid, info in self.nodes.items():
+            if info.alive:
+                avail = self.node_resources.get(nid, {}).get("available")
+                if avail is None:  # pre-first-heartbeat: use static totals
+                    avail = dict(info.resources or {})
+                free[nid] = dict(avail)
+        if not free:
+            return None
+
+        def fits(nid, res):
+            return all(free[nid].get(r, 0.0) >= q for r, q in res.items())
+
+        def charge(nid, res):
+            for r, q in res.items():
+                free[nid][r] = free[nid].get(r, 0.0) - q
+
+        unplaced = [
+            (i, rec.bundles[i])
+            for i in range(len(rec.bundles))
+            if rec.assignment[i] is None
+        ]
+        plan: List[Optional[bytes]] = list(rec.assignment)
+        if rec.strategy == "STRICT_PACK":
+            anchored = {n for n in rec.assignment if n is not None}
+            cands = list(anchored) if anchored else list(free)
+            for nid in cands:
+                trial = dict(free[nid])
+                ok = True
+                for _, b in unplaced:
+                    for r, q in b.items():
+                        trial[r] = trial.get(r, 0.0) - q
+                        if trial[r] < 0:
+                            ok = False
+                    if not ok:
+                        break
+                if ok:
+                    for i, b in unplaced:
+                        plan[i] = nid
+                    return plan  # all on one node
+            return None
+        used = {n for n in rec.assignment if n is not None}
+        for i, b in unplaced:
+            if rec.strategy == "STRICT_SPREAD":
+                cands = [n for n in free if n not in used and fits(n, b)]
+            elif rec.strategy == "SPREAD":
+                fresh = [n for n in free if n not in used and fits(n, b)]
+                cands = fresh or [n for n in free if fits(n, b)]
+            else:  # PACK: prefer nodes already in use
+                cands = sorted(
+                    (n for n in free if fits(n, b)),
+                    key=lambda n: (n not in used,),
+                )
+            if not cands:
+                return None
+            nid = cands[0]
+            plan[i] = nid
+            charge(nid, b)
+            used.add(nid)
+        return plan
+
+    async def _place_pg(self, rec: PgRecord):
+        backoff = 0.1
+        while rec.state in (PG_PENDING, PG_RESCHEDULING):
+            plan = self._plan_bundles(rec)
+            if plan is None or any(p is None for p in plan):
+                await asyncio.sleep(min(backoff, 1.0))
+                backoff *= 1.5
+                continue
+            # group NEW bundles per node
+            per_node: Dict[bytes, List] = {}
+            for i, nid in enumerate(plan):
+                if rec.assignment[i] is None:
+                    per_node.setdefault(nid, []).append(
+                        [i, rec.bundles[i]]
+                    )
+            # PREPARE phase
+            prepared: List[bytes] = []
+            ok = True
+            for nid, items in per_node.items():
+                raylet = self._raylet_clients.get(nid)
+                if raylet is None or raylet.closed:
+                    ok = False
+                    break
+                try:
+                    r = await raylet.call_async(
+                        "prepare_bundles",
+                        {"pg_id": rec.pg_id, "bundles": items},
+                        timeout=15,
+                    )
+                except Exception:
+                    r = {"ok": False}
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append(nid)
+            if not ok or rec.state == PG_REMOVED:
+                for nid in prepared:
+                    raylet = self._raylet_clients.get(nid)
+                    if raylet is not None and not raylet.closed:
+                        try:
+                            await raylet.call_async(
+                                "cancel_bundles", rec.pg_id, timeout=10
+                            )
+                        except Exception:
+                            pass
+                if rec.state == PG_REMOVED:
+                    return
+                await asyncio.sleep(min(backoff, 1.0))
+                backoff *= 1.5
+                continue
+            # COMMIT phase. Publish the tentative assignment FIRST so the
+            # node-death handler can void entries while commits are in
+            # flight; any bundle whose commit fails (node died mid-2PC) is
+            # cleared and re-placed by the next loop iteration.
+            rec.assignment = plan
+            for nid, items in per_node.items():
+                committed = False
+                raylet = self._raylet_clients.get(nid)
+                if raylet is not None and not raylet.closed:
+                    try:
+                        r = await raylet.call_async(
+                            "commit_bundles", rec.pg_id, timeout=15
+                        )
+                        committed = bool(r.get("ok"))
+                    except Exception:
+                        committed = False
+                if not committed:
+                    for i, _ in items:
+                        rec.assignment[i] = None
+            if rec.state == PG_REMOVED:  # removed during commit: roll back
+                await self.rpc_remove_placement_group(None, rec.pg_id)
+                return
+            if any(a is None for a in rec.assignment):
+                continue  # a commit failed or a node died: re-place the rest
+            rec.state = PG_CREATED
+            self._publish("placement_groups", [rec.to_wire()])
+            logger.info("placement group %s created over %d node(s)",
+                        rec.pg_id.hex()[:12], len(set(plan)))
+            return
 
     # ---------------- object directory ----------------
     # Locations of plasma objects (node ids). Parity: the reference resolves

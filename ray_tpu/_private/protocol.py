@@ -92,3 +92,14 @@ class NodeInfo:
     @classmethod
     def from_wire(cls, w):
         return cls(**w)
+
+
+def parse_pg_strategy(strategy):
+    """Wire-form ["pg", hex_id, bundle_index] -> (pg_id bytes, idx) or None.
+
+    Single decode point for every consumer (raylet lease/queue paths, GCS
+    actor scheduler) of PlacementGroupSchedulingStrategy.to_wire().
+    """
+    if isinstance(strategy, (list, tuple)) and strategy and strategy[0] == "pg":
+        return bytes.fromhex(str(strategy[1])), int(strategy[2])
+    return None

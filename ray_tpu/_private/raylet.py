@@ -52,11 +52,13 @@ class WorkerHandle:
 
 class Lease:
     def __init__(self, lease_id: bytes, worker: WorkerHandle, resources: Dict,
-                 owner_conn=None):
+                 owner_conn=None, alloc=None):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.owner_conn = owner_conn  # requesting conn; reclaim on its death
+        # Where the resources were charged: ("node",) or ("bundle", pg_id, idx)
+        self.alloc = alloc or ("node",)
         self.granted_at = time.monotonic()
 
 
@@ -98,6 +100,13 @@ class Raylet:
         self._owner_leases: Dict[Any, Set[bytes]] = {}
         self.cluster_resources: Dict[str, Dict] = {}  # node hex -> view
         self.cluster_nodes: Dict[str, Dict] = {}  # node hex -> NodeInfo wire
+        # Placement-group bundle reservation (2PC; parity: reference raylet
+        # PG resource manager, placement_group_resource_manager.h:46):
+        # prepared = reserved but revocable; committed = live bundle pools.
+        self.pg_prepared: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self.pg_prepare_ttl: Dict[bytes, Any] = {}  # pg_id -> TimerHandle
+        self.pg_bundle_total: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self.pg_bundle_avail: Dict[bytes, Dict[int, Dict[str, float]]] = {}
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -289,7 +298,7 @@ class Raylet:
                 s = self._owner_leases.get(lease.owner_conn)
                 if s is not None:
                     s.discard(lease.lease_id)
-            self._release_resources(lease.resources)
+            self._release_alloc(lease.alloc, lease.resources)
         if w.actor_id is not None and not self._stopping:
             try:
                 await self.gcs.call_async(
@@ -336,6 +345,159 @@ class Raylet:
                 self.total_resources.get(r, 0.0),
             )
 
+    # ------------- placement-group bundles (2PC participant) -------------
+    # Parity: reference node_manager.proto:380-388 (PrepareBundleResources /
+    # CommitBundleResources / CancelResourceReserve) + the GCS-side 2PC in
+    # gcs_placement_group_scheduler.h:275.
+
+    async def rpc_prepare_bundles(self, conn, data):
+        """Atomically reserve this node's share of a PG: ALL bundles in
+        ``data["bundles"]`` or none. Reservation is revocable until commit
+        (TTL guards against a GCS that dies between prepare and commit).
+        Idempotent under coordinator retries: indices already prepared or
+        committed here are not charged twice."""
+        pg_id = data["pg_id"]
+        bundles = {int(i): dict(res) for i, res in data["bundles"]}
+        already = set(self.pg_prepared.get(pg_id, {})) | set(
+            self.pg_bundle_total.get(pg_id, {})
+        )
+        bundles = {i: r for i, r in bundles.items() if i not in already}
+        need: Dict[str, float] = {}
+        for res in bundles.values():
+            for r, q in res.items():
+                need[r] = need.get(r, 0.0) + q
+        if not self._can_fit(need):
+            return {"ok": False, "error": "insufficient resources"}
+        self._acquire_resources(need)
+        self.pg_prepared.setdefault(pg_id, {}).update(bundles)
+        old = self.pg_prepare_ttl.pop(pg_id, None)
+        if old is not None:
+            old.cancel()
+        self.pg_prepare_ttl[pg_id] = asyncio.get_running_loop().call_later(
+            30.0, self._expire_prepared, pg_id
+        )
+        return {"ok": True}
+
+    def _expire_prepared(self, pg_id: bytes):
+        self.pg_prepare_ttl.pop(pg_id, None)
+        bundles = self.pg_prepared.pop(pg_id, None)
+        if bundles:
+            for res in bundles.values():
+                self._release_resources(res)
+            self._pump_lease_queue()
+
+    async def rpc_commit_bundles(self, conn, pg_id: bytes):
+        ttl = self.pg_prepare_ttl.pop(pg_id, None)
+        if ttl is not None:
+            ttl.cancel()
+        bundles = self.pg_prepared.pop(pg_id, None)
+        if bundles is None:
+            return {"ok": False, "error": "nothing prepared"}
+        self.pg_bundle_total.setdefault(pg_id, {}).update(
+            {i: dict(r) for i, r in bundles.items()}
+        )
+        self.pg_bundle_avail.setdefault(pg_id, {}).update(
+            {i: dict(r) for i, r in bundles.items()}
+        )
+        self._pump_lease_queue()
+        return {"ok": True}
+
+    async def rpc_cancel_bundles(self, conn, pg_id: bytes):
+        self._expire_prepared(pg_id)
+        return {"ok": True}
+
+    async def rpc_release_bundles(self, conn, pg_id: bytes):
+        """PG removed: kill leases running in its bundles, return capacity."""
+        self._expire_prepared(pg_id)
+        totals = self.pg_bundle_total.pop(pg_id, None)
+        self.pg_bundle_avail.pop(pg_id, None)
+        if totals is None:
+            return {"ok": True}
+        # Reference semantics: removing a PG kills tasks/actors inside it.
+        for lease in list(self.leases.values()):
+            if lease.alloc[0] == "bundle" and lease.alloc[1] == pg_id:
+                w = lease.worker
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.terminate()
+        # Queued lease requests against this PG would wait forever on the
+        # vanished pools — fail them now with an explicit error.
+        from ray_tpu._private.protocol import parse_pg_strategy
+
+        still_queued = []
+        for summary, fut, qconn in self.lease_queue:
+            parsed = parse_pg_strategy(summary.get("strategy"))
+            if parsed is not None and parsed[0] == pg_id and not fut.done():
+                fut.set_result(
+                    {"infeasible": True, "error": "placement group removed"}
+                )
+            else:
+                still_queued.append((summary, fut, qconn))
+        self.lease_queue = still_queued
+        for res in totals.values():
+            self._release_resources(res)
+        self._pump_lease_queue()
+        return {"ok": True}
+
+    # ------------- allocation (node pool vs bundle pools) -------------
+
+    def _bundle_can_fit(self, pg_id: bytes, idx: int,
+                        resources: Dict[str, float]) -> bool:
+        pool = self.pg_bundle_avail.get(pg_id, {}).get(idx)
+        return pool is not None and all(
+            pool.get(r, 0.0) >= q for r, q in resources.items()
+        )
+
+    def _can_acquire(self, summary: Dict) -> bool:
+        """Non-mutating twin of ``_try_acquire``."""
+        from ray_tpu._private.protocol import parse_pg_strategy
+
+        resources = summary.get("resources") or {}
+        parsed = parse_pg_strategy(summary.get("strategy"))
+        if parsed is not None:
+            pg_id, want_idx = parsed
+            pools = self.pg_bundle_avail.get(pg_id, {})
+            indices = [want_idx] if want_idx >= 0 else sorted(pools)
+            return any(
+                self._bundle_can_fit(pg_id, i, resources) for i in indices
+            )
+        return self._can_fit(resources)
+
+    def _try_acquire(self, summary: Dict) -> Optional[Tuple]:
+        """Charge the request against the node pool, or — for PG-strategy
+        requests — against one of this node's committed bundle pools.
+        Returns the alloc tag, or None if it cannot be satisfied now."""
+        from ray_tpu._private.protocol import parse_pg_strategy
+
+        resources = summary.get("resources") or {}
+        parsed = parse_pg_strategy(summary.get("strategy"))
+        if parsed is not None:
+            pg_id, want_idx = parsed
+            pools = self.pg_bundle_avail.get(pg_id, {})
+            indices = [want_idx] if want_idx >= 0 else sorted(pools)
+            for i in indices:
+                if self._bundle_can_fit(pg_id, i, resources):
+                    pool = pools[i]
+                    for r, q in resources.items():
+                        pool[r] = pool.get(r, 0.0) - q
+                    return ("bundle", pg_id, i)
+            return None
+        if not self._can_fit(resources):
+            return None
+        self._acquire_resources(resources)
+        return ("node",)
+
+    def _release_alloc(self, alloc: Tuple, resources: Dict[str, float]):
+        if alloc[0] == "bundle":
+            _, pg_id, idx = alloc
+            total = self.pg_bundle_total.get(pg_id, {}).get(idx)
+            pool = self.pg_bundle_avail.get(pg_id, {}).get(idx)
+            if pool is None or total is None:
+                return  # bundle released while lease ran; capacity returned
+            for r, q in resources.items():
+                pool[r] = min(pool.get(r, 0.0) + q, total.get(r, 0.0))
+        else:
+            self._release_resources(resources)
+
     # ------------- lease protocol -------------
     async def rpc_request_worker_lease(self, conn, summary: Dict):
         """Grant a worker lease, queue, or spill to another node.
@@ -357,6 +519,9 @@ class Raylet:
         strategy = summary.get("strategy")
         hops = int(summary.get("hops") or 0)
         me = self.node_id.hex()
+
+        if isinstance(strategy, (list, tuple)) and strategy and strategy[0] == "pg":
+            return await self._lease_for_pg(summary, conn)
 
         if isinstance(strategy, (list, tuple)) and strategy and strategy[0] == "affinity":
             target_hex, soft = str(strategy[1]), bool(strategy[2])
@@ -425,6 +590,78 @@ class Raylet:
         self._pump_lease_queue()
         return await fut
 
+    async def _lease_for_pg(self, summary: Dict, conn):
+        """Lease inside a placement-group bundle: serve locally when this
+        node holds a fitting committed bundle, else route to the node the GCS
+        assigned the bundle to. Parity: PlacementGroupSchedulingStrategy
+        consulting bundle locations (reference bundle_scheduling_policy.h:31).
+        """
+        import random
+
+        from ray_tpu._private.protocol import parse_pg_strategy
+
+        pg_id, want_idx = parse_pg_strategy(summary["strategy"])
+        resources = summary.get("resources") or {}
+        deadline = time.monotonic() + GLOBAL_CONFIG.infeasible_task_grace_s
+
+        def fits(spec: Dict[str, float]) -> bool:
+            return all(spec.get(r, 0.0) >= q for r, q in resources.items())
+
+        while True:
+            # Local fast path: a committed bundle here can (eventually) serve
+            # the request — queue locally. (For -1 this prefers the local
+            # bundle even if a remote one is currently freer.)
+            totals = self.pg_bundle_total.get(pg_id, {})
+            local_ok = [
+                i for i in ([want_idx] if want_idx >= 0 else sorted(totals))
+                if i in totals and fits(totals[i])
+            ]
+            if local_ok:
+                fut = asyncio.get_running_loop().create_future()
+                self.lease_queue.append((summary, fut, conn))
+                self._watch_owner(conn)
+                self._pump_lease_queue()
+                return await fut
+            try:
+                rec = await self.gcs.call_async(
+                    "get_placement_group", pg_id, timeout=10
+                )
+            except Exception:
+                rec = None
+            if rec is None or rec.get("state") == "REMOVED":
+                return {"infeasible": True, "error": "placement group removed"}
+            # Capacity is judged against the PG's declared bundle specs
+            # cluster-wide, not just bundles committed on this node.
+            bundles = rec.get("bundles") or []
+            cand_idx = (
+                [want_idx] if want_idx >= 0 else list(range(len(bundles)))
+            )
+            fitting = [
+                i for i in cand_idx if i < len(bundles) and fits(bundles[i])
+            ]
+            if not fitting:
+                return {"infeasible": True,
+                        "error": "request exceeds bundle capacity"}
+            if rec.get("state") == "CREATED":
+                assignment = rec.get("assignment") or []
+                cands = [
+                    bytes(assignment[i])
+                    for i in fitting
+                    if i < len(assignment) and assignment[i] is not None
+                ]
+                remote = [c for c in cands if c != self.node_id]
+                if remote and self.node_id not in cands:
+                    target = random.choice(remote)
+                    node = self.cluster_nodes.get(target.hex())
+                    if node and node.get("alive", True):
+                        return {"spillback": node["raylet_addr"]}
+                # a fitting bundle is assigned here but not committed yet:
+                # brief wait below
+            if time.monotonic() > deadline:
+                return {"infeasible": True,
+                        "error": "placement group never became ready"}
+            await asyncio.sleep(0.2)
+
     def _watch_owner(self, conn):
         """Ensure an owner conn has a close handler reclaiming its leases and
         cancelling its queued lease requests."""
@@ -439,7 +676,7 @@ class Raylet:
             lease = self.leases.pop(lid, None)
             if lease is None:
                 continue
-            self._release_resources(lease.resources)
+            self._release_alloc(lease.alloc, lease.resources)
             w = lease.worker
             w.lease_id = None
             # The owner died mid-lease: the worker may be running a task whose
@@ -511,7 +748,7 @@ class Raylet:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
-            if not self._can_fit(resources):
+            if not self._can_acquire(summary):
                 remaining.append((summary, fut, conn))
                 continue
             tpu_needed = resources.get("TPU", 0) > 0
@@ -520,11 +757,15 @@ class Raylet:
                 remaining.append((summary, fut, conn))
                 self._maybe_spawn_worker(tpu_needed)
                 continue
+            alloc = self._try_acquire(summary)
+            if alloc is None:  # e.g. bundle pool exhausted while queued
+                self.idle.append(w)
+                remaining.append((summary, fut, conn))
+                continue
             lease_id = os.urandom(16)
-            self._acquire_resources(resources)
             w.lease_id = lease_id
             self.leases[lease_id] = Lease(lease_id, w, resources,
-                                          owner_conn=conn)
+                                          owner_conn=conn, alloc=alloc)
             if conn is not None:
                 self._owner_leases.setdefault(conn, set()).add(lease_id)
             fut.set_result(
@@ -565,7 +806,7 @@ class Raylet:
             s = self._owner_leases.get(lease.owner_conn)
             if s is not None:
                 s.discard(lease_id)
-        self._release_resources(lease.resources)
+        self._release_alloc(lease.alloc, lease.resources)
         w = lease.worker
         w.lease_id = None
         if reusable and w.alive and w.actor_id is None:
@@ -579,10 +820,22 @@ class Raylet:
     async def rpc_create_actor(self, conn, spec: Dict):
         """Called by the GCS: dedicate a worker and run the creation task."""
         resources = spec.get("resources") or {}
-        if not self._feasible(resources):
+        strategy = spec.get("scheduling_strategy")
+        is_pg = isinstance(strategy, (list, tuple)) and strategy and (
+            strategy[0] == "pg"
+        )
+        if is_pg:
+            if not self._can_acquire(
+                {"resources": resources, "strategy": strategy}
+            ):
+                return {"ok": False, "error": "bundle not on this node / full"}
+        elif not self._feasible(resources):
             return {"ok": False, "error": "infeasible on this node"}
         fut = asyncio.get_running_loop().create_future()
-        self.lease_queue.append(({"resources": resources}, fut, None))
+        summary = {"resources": resources}
+        if is_pg:
+            summary["strategy"] = strategy
+        self.lease_queue.append((summary, fut, None))
         self._pump_lease_queue()
         try:
             grant = await asyncio.wait_for(fut, timeout=90)
@@ -595,7 +848,7 @@ class Raylet:
             lease = self.leases.pop(lease_id, None)
             if lease is None:
                 return
-            self._release_resources(lease.resources)
+            self._release_alloc(lease.alloc, lease.resources)
             lw = lease.worker
             lw.lease_id = None
             lw.actor_id = None

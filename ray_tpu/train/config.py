@@ -34,6 +34,10 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     mesh: Optional[MeshConfig] = None
     devices_per_worker: Optional[int] = None
+    # Gang placement: reserve one bundle per worker via a placement group
+    # before starting (None = schedule workers individually). STRICT_SPREAD
+    # = one worker per host, the TPU-pod layout.
+    placement_strategy: Optional[str] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -102,6 +102,7 @@ class JaxTrainer:
             sc.num_workers,
             sc.worker_resources(),
             devices_per_worker=sc.devices_per_worker,
+            placement_strategy=sc.placement_strategy,
         )
         try:
             group.bootstrap_distributed()

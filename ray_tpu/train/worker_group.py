@@ -148,13 +148,52 @@ class WorkerGroup:
 
     def __init__(self, num_workers: int, resources: Dict[str, float],
                  devices_per_worker: Optional[int] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 placement_strategy: Optional[str] = None):
         self.num_workers = num_workers
+        self.pg = None
+        self.workers = []
+        try:
+            self._create(num_workers, resources, devices_per_worker, env,
+                         placement_strategy)
+        except BaseException:
+            # Failed init must not leak the placement group / actors.
+            self.shutdown(graceful=False)
+            raise
+
+    def _create(self, num_workers, resources, devices_per_worker, env,
+                placement_strategy):
         opts = {"resources": dict(resources), "max_restarts": 0}
         if resources.get("TPU"):
             opts["num_tpus"] = resources["TPU"]
         actor_cls = ray_tpu.remote(**opts)(_TrainWorker)
-        self.workers = [actor_cls.remote() for _ in range(num_workers)]
+        if placement_strategy is not None:
+            # Gang-reserve one bundle per worker (2PC in the GCS), then pin
+            # worker i into bundle i — atomic multi-host placement, the
+            # reference Train + PG pattern and the TPU-slice layout.
+            from ray_tpu.util.placement_group import placement_group
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            self.pg = placement_group(
+                [dict(resources) for _ in range(num_workers)],
+                strategy=placement_strategy,
+            )
+            if not self.pg.wait(timeout_seconds=120):
+                raise TimeoutError(
+                    "placement group for the worker group was not placed"
+                )
+            self.workers = [
+                actor_cls.options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        self.pg, placement_group_bundle_index=i
+                    )
+                ).remote()
+                for i in range(num_workers)
+            ]
+        else:
+            self.workers = [actor_cls.remote() for _ in range(num_workers)]
         env = dict(env or {})
         ray_tpu.get(
             [w.init_runtime.remote(env, devices_per_worker)
@@ -210,5 +249,12 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self.pg)
             except Exception:
                 pass

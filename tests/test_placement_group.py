@@ -1,0 +1,164 @@
+"""Placement-group tests on the simulated multi-node cluster.
+
+Parity surfaces: reference ``python/ray/tests/test_placement_group*.py`` —
+atomic all-or-nothing (2PC) reservation, strategy semantics, bundle-scoped
+scheduling, removal releasing resources, node-death rescheduling.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@pytest.fixture
+def cluster3():
+    """Three 2-CPU nodes."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2}},
+    )
+    c.extra_nodes = [c.add_node(num_cpus=2), c.add_node(num_cpus=2)]
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def test_strict_spread_places_and_pins(cluster3):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=60)
+    rec = pg.table()
+    nodes = [bytes(n).hex() for n in rec["assignment"]]
+    assert len(set(nodes)) == 3  # one bundle per node, all distinct
+
+    # tasks pinned to bundle i must run on the bundle's node
+    for i in range(3):
+        ran_on = ray_tpu.get(
+            where.options(
+                num_cpus=1,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    pg, placement_group_bundle_index=i
+                ),
+            ).remote(),
+            timeout=60,
+        )
+        assert ran_on == nodes[i], (i, ran_on, nodes)
+
+
+def test_atomic_all_or_nothing(cluster3):
+    """A STRICT_SPREAD group needing 4 distinct nodes on a 3-node cluster
+    must reserve NOTHING (no partial placement)."""
+    pg = placement_group([{"CPU": 1}] * 4, strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout_seconds=2)
+    # nothing reserved: all 6 CPUs still usable by plain tasks
+    refs = [where.options(num_cpus=1).remote() for _ in range(6)]
+    assert len(ray_tpu.get(refs, timeout=120)) == 6
+    remove_placement_group(pg)
+
+
+def test_pending_pg_places_when_node_joins(cluster3):
+    pg = placement_group([{"CPU": 4}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=2)  # no node has 4 CPUs
+    cluster3.add_node(num_cpus=4)
+    assert pg.wait(timeout_seconds=60)
+
+
+def test_remove_releases_bundles(cluster3):
+    # reserve ALL cluster CPUs
+    pg = placement_group([{"CPU": 2}] * 3, strategy="SPREAD")
+    assert pg.wait(timeout_seconds=60)
+    # a 2-CPU task cannot run anywhere while the PG holds everything...
+    ref = where.options(num_cpus=2).remote()
+    ready, _ = ray_tpu.wait([ref], timeout=2)
+    assert not ready
+    # ...until the group is removed
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=60)
+
+
+def test_bundle_capacity_enforced(cluster3):
+    """Tasks beyond a bundle's capacity queue; an oversized request errors."""
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=60)
+    strat = PlacementGroupSchedulingStrategy(pg, 0)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strat)
+    def hold():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # two tasks serialize through the 1-CPU bundle
+    t0 = time.monotonic()
+    nodes = ray_tpu.get([hold.remote(), hold.remote()], timeout=120)
+    assert len(set(nodes)) == 1
+    assert time.monotonic() - t0 >= 2.0
+
+    with pytest.raises(Exception):
+        ray_tpu.get(
+            where.options(num_cpus=2, scheduling_strategy=strat).remote(),
+            timeout=60,
+        )
+
+
+def test_actor_in_placement_group(cluster3):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=60)
+    rec = pg.table()
+
+    @ray_tpu.remote
+    class Locator:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Locator.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 1),
+    ).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == bytes(
+        rec["assignment"][1]
+    ).hex()
+
+
+def test_pg_rescheduled_after_node_death(cluster3):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=60)
+    rec = pg.table()
+    head_id = cluster3.head_node.node_id
+    victim_nid = next(
+        bytes(n) for n in rec["assignment"] if bytes(n) != head_id
+    )
+    victim = next(
+        n for n in cluster3.extra_nodes if n.node_id == victim_nid
+    )
+    cluster3.remove_node(victim)
+    # group drops to RESCHEDULING, then re-places on the remaining node
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        rec = pg.table()
+        nodes = {bytes(n) for n in rec["assignment"] if n is not None}
+        if rec["state"] == "CREATED" and victim_nid not in nodes:
+            break
+        time.sleep(0.2)
+    assert rec["state"] == "CREATED"
+    assert victim_nid not in {bytes(n) for n in rec["assignment"]}
+
+
+def test_placement_group_table(cluster3):
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="mine")
+    assert pg.wait(timeout_seconds=60)
+    table = placement_group_table()
+    assert pg.id.hex() in table
+    assert table[pg.id.hex()]["name"] == "mine"

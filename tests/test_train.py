@@ -247,3 +247,55 @@ def test_flagship_transformer_via_trainer(rt_train, tmp_path):
         run_config=RunConfig(name="flagship", storage_path=str(tmp_path)),
     ).fit()
     assert result.metrics["step"] == 2
+
+
+def test_worker_group_gang_placed_via_pg():
+    """Trainer worker group reserved atomically via a placement group:
+    STRICT_SPREAD puts one train worker on each simulated host."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"resources": {"CPU": 3}})
+    c.add_node(num_cpus=3)
+    c.connect()
+    try:
+        def loop(config):
+            import ray_tpu
+            from ray_tpu.train import session
+
+            session.report({
+                "node": ray_tpu.get_runtime_context().get_node_id(),
+                "rank": session.get_world_rank(),
+            })
+
+        nodes = {}
+
+        class Collect(JaxTrainer):
+            def _drain(self, group):
+                polls = None
+                # use the standard drain but capture every rank's report
+                import ray_tpu.train.trainer as tr
+                last = {}
+                done = [False] * group.num_workers
+                while not all(done):
+                    polls = group.poll_all(timeout=10.0)
+                    for rank, p in enumerate(polls):
+                        for ev in p["events"]:
+                            nodes[rank] = ev["metrics"]["node"]
+                            last = ev["metrics"]
+                        if p["done"]:
+                            if p["error"] is not None:
+                                raise tr.TrainingFailedError(str(p["error"]))
+                            done[rank] = True
+                return last
+
+        Collect(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, placement_strategy="STRICT_SPREAD"
+            ),
+        ).fit()
+        assert len(nodes) == 2
+        assert nodes[0] != nodes[1], f"workers not spread: {nodes}"
+    finally:
+        c.shutdown()
