@@ -44,6 +44,13 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "dense"  # dense | ring | flash
+    # Mixture-of-experts FFN (0 = dense MLP). Experts shard over the `ep`
+    # mesh axis; dispatch/combine einsums carry GSPMD sharding constraints so
+    # XLA inserts the expert all-to-all (reference has NO EP — SURVEY §2.5).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
     remat: bool = False
     # What the checkpointed layer saves: "dots" keeps matmul outputs (cheap
     # elementwise recompute only, ~0 extra FLOPs), "full" saves nothing
@@ -64,7 +71,11 @@ class TransformerConfig:
             self.kv_heads,
             self.d_head,
         )
-        per_layer = d * dh * (h + 2 * kv) + h * dh * d + 2 * d * f + d
+        if self.moe_experts:
+            ffn = d * self.moe_experts + 2 * self.moe_experts * d * f
+        else:
+            ffn = 2 * d * f
+        per_layer = d * dh * (h + 2 * kv) + h * dh * d + ffn + d
         head = 0 if self.tie_embeddings else d * self.vocab_size
         return self.vocab_size * d + self.n_layers * per_layer + d + head
 
@@ -126,11 +137,20 @@ def init_params(config: TransformerConfig, rng: jax.Array) -> Dict:
             "wo": dense_init(k_o, (L, c.n_heads, c.d_head, c.d_model),
                              c.n_heads * c.d_head),
         },
-        "mlp": {
+    }
+    if c.moe_experts:
+        E = c.moe_experts
+        k_rt = jax.random.fold_in(k_wi, 1)
+        layers["moe"] = {
+            "router": dense_init(k_rt, (L, c.d_model, E), c.d_model),
+            "wi": dense_init(k_wi, (L, E, c.d_model, c.d_ff), c.d_model),
+            "wo": dense_init(k_wo, (L, E, c.d_ff, c.d_model), c.d_ff),
+        }
+    else:
+        layers["mlp"] = {
             "wi": dense_init(k_wi, (L, c.d_model, c.d_ff), c.d_model),
             "wo": dense_init(k_wo, (L, c.d_ff, c.d_model), c.d_ff),
-        },
-    }
+        }
     params = {
         "embed": (jax.random.normal(k_emb, (c.vocab_size, c.d_model)) * 0.02
                   ).astype(pd),
@@ -155,13 +175,20 @@ def param_logical_axes(config: TransformerConfig) -> Dict:
                 "wv": ("layers", "embed", "kv_heads", "head_dim"),
                 "wo": ("layers", "heads", "head_dim", "embed"),
             },
-            "mlp": {
-                "wi": ("layers", "embed", "mlp"),
-                "wo": ("layers", "mlp", "embed"),
-            },
         },
         "final_ln": {"scale": ("embed",)},
     }
+    if config.moe_experts:
+        axes["layers"]["moe"] = {
+            "router": ("layers", "embed", "experts"),
+            "wi": ("layers", "experts", "embed", "mlp"),
+            "wo": ("layers", "experts", "mlp", "embed"),
+        }
+    else:
+        axes["layers"]["mlp"] = {
+            "wi": ("layers", "embed", "mlp"),
+            "wo": ("layers", "mlp", "embed"),
+        }
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -199,24 +226,16 @@ def _rotary(q, k, rotary_dim, positions):
     return rot(q), rot(k)
 
 
-def forward(
-    params: Dict,
-    tokens: jax.Array,  # [B, S] int32
-    config: TransformerConfig,
-    mesh: Optional[jax.sharding.Mesh] = None,
-) -> jax.Array:
-    """Returns logits [B, S, vocab]. `mesh` is required for attn_impl='ring'."""
+def select_attn_fn(config: TransformerConfig,
+                   mesh: Optional[jax.sharding.Mesh]):
     c = config
-    x = params["embed"].astype(c.dtype)[tokens]  # [B, S, D]
-    positions = jnp.arange(tokens.shape[1])
-
     if c.attn_impl == "ring":
         if mesh is None:
             raise ValueError("ring attention needs a mesh")
         from ray_tpu.ops.ring_attention import ring_attention
 
-        attn_fn = partial(ring_attention, mesh=mesh)
-    elif c.attn_impl == "flash":
+        return partial(ring_attention, mesh=mesh)
+    if c.attn_impl == "flash":
         from ray_tpu.ops.flash_attention import (
             flash_attention,
             flash_attention_sharded,
@@ -225,39 +244,91 @@ def forward(
         # pallas_call is opaque to the GSPMD partitioner: under a mesh it
         # must sit inside shard_map (batch->dp, heads->tp).
         if mesh is not None:
-            attn_fn = partial(flash_attention_sharded, mesh=mesh)
-        else:
-            attn_fn = flash_attention
-    else:
-        attn_fn = causal_attention
+            return partial(flash_attention_sharded, mesh=mesh)
+        return flash_attention
+    if c.attn_impl == "dense":
+        return causal_attention
+    raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
 
-    def layer(x, lp):
-        # GPT-J parallel block: y = x + attn(ln(x)) + mlp(ln(x))
-        h = _rms_norm(x, lp["ln1"]["scale"])
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].astype(c.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].astype(c.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].astype(c.dtype))
-        q, k = _rotary(q, k, c.rotary_dim, positions)
-        a = attn_fn(q, k, v)
-        a = jnp.einsum("bshk,hkd->bsd", a, lp["attn"]["wo"].astype(c.dtype))
+
+def apply_layer(
+    x: jax.Array,  # [B, S, D]
+    lp: Dict,  # ONE layer's params (no leading L dim)
+    config: TransformerConfig,
+    positions: jax.Array,
+    attn_fn,
+    mesh: Optional[jax.sharding.Mesh] = None,
+):
+    """GPT-J parallel block: y = x + attn(ln(x)) + ffn(ln(x)).
+
+    Shared by the scanned single-program forward below and the pipeline
+    schedule (parallel/pipeline.py). Returns (y, aux_loss)."""
+    c = config
+    h = _rms_norm(x, lp["ln1"]["scale"])
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].astype(c.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].astype(c.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].astype(c.dtype))
+    q, k = _rotary(q, k, c.rotary_dim, positions)
+    a = attn_fn(q, k, v)
+    a = jnp.einsum("bshk,hkd->bsd", a, lp["attn"]["wo"].astype(c.dtype))
+    if c.moe_experts:
+        from ray_tpu.ops.moe import moe_ffn
+
+        m, aux = moe_ffn(
+            h,
+            lp["moe"]["router"],
+            lp["moe"]["wi"],
+            lp["moe"]["wo"],
+            top_k=c.moe_top_k,
+            capacity_factor=c.moe_capacity_factor,
+            mesh=mesh,
+        )
+    else:
         m = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["wi"].astype(c.dtype))
         m = jax.nn.gelu(m)
         m = jnp.einsum("bsf,fd->bsd", m, lp["mlp"]["wo"].astype(c.dtype))
-        return x + a + m, None
+        aux = jnp.zeros((), jnp.float32)
+    return x + a + m, aux
 
-    if c.remat:
-        if c.remat_policy == "full":
-            policy = None  # save nothing: classic full-layer remat
-        elif c.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        else:
-            raise ValueError(f"unknown remat_policy {c.remat_policy!r}")
-        layer = jax.checkpoint(layer, policy=policy)
-    x, _ = lax.scan(layer, x, params["layers"])
+
+def remat_wrap(layer_fn, config: TransformerConfig):
+    if not config.remat:
+        return layer_fn
+    if config.remat_policy == "full":
+        policy = None  # save nothing: classic full-layer remat
+    elif config.remat_policy == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    else:
+        raise ValueError(f"unknown remat_policy {config.remat_policy!r}")
+    return jax.checkpoint(layer_fn, policy=policy)
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # [B, S] int32
+    config: TransformerConfig,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    return_aux: bool = False,
+):
+    """Returns logits [B, S, vocab] (and the MoE aux loss if return_aux)."""
+    c = config
+    x = params["embed"].astype(c.dtype)[tokens]  # [B, S, D]
+    positions = jnp.arange(tokens.shape[1])
+    attn_fn = select_attn_fn(c, mesh)
+
+    def layer(carry, lp):
+        x, aux = carry
+        y, a = apply_layer(x, lp, c, positions, attn_fn, mesh=mesh)
+        return (y, aux + a), None
+
+    layer = remat_wrap(layer, c)
+    (x, aux), _ = lax.scan(
+        layer, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
     x = _rms_norm(x, params["final_ln"]["scale"])
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(c.dtype))
-    return logits
+    return (logits, aux) if return_aux else logits
 
 
 def loss_fn(
@@ -266,10 +337,16 @@ def loss_fn(
     config: TransformerConfig,
     mesh: Optional[jax.sharding.Mesh] = None,
 ) -> jax.Array:
-    logits = forward(params, batch["tokens"], config, mesh).astype(jnp.float32)
+    logits, aux = forward(
+        params, batch["tokens"], config, mesh, return_aux=True
+    )
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones_like(ll)
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    ce = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if config.moe_experts:
+        ce = ce + config.moe_aux_weight * aux / config.n_layers
+    return ce

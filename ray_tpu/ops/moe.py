@@ -1,0 +1,107 @@
+"""Mixture-of-experts FFN with GSPMD expert parallelism.
+
+GShard/Switch-style top-k routing with per-expert capacity: tokens are
+dispatched to [E, G, C, D] expert buffers via one-hot dispatch/combine
+tensors, the expert FFN runs with the E axis sharded over the ``ep`` mesh
+axis, and ``with_sharding_constraint`` re-layouts make XLA insert the
+dispatch/return all-to-alls over ICI. No hand-written collectives — the
+partitioner derives them, which is the TPU-native shape of expert
+parallelism (the reference ships NO EP/MoE at all — SURVEY.md §2.5).
+
+Refs: GShard (Lepikhin et al.), Switch Transformers (Fedus et al.) — see
+PAPERS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _top_k_mask(probs: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """probs [G,N,E] -> (gates [G,N,E] zeroed outside top-k, masks [k,G,N,E]
+    one-hot per choice slot)."""
+    masks = []
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype)
+        masks.append(m)
+        remaining = remaining * (1.0 - m)
+    mask = jnp.stack(masks)  # [k, G, N, E]
+    gates = probs * mask.sum(0)
+    # renormalize the kept gates so they sum to 1 per token
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    return gates / denom, mask
+
+
+def moe_ffn(
+    x: jax.Array,  # [G, N, D] tokens (G = batch rows, sharded dp/ep)
+    router_w: jax.Array,  # [D, E]
+    wi: jax.Array,  # [E, D, F]
+    wo: jax.Array,  # [E, F, D]
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (out [G, N, D], aux_loss scalar).
+
+    Capacity C = ceil(top_k * N / E * capacity_factor); tokens routed beyond
+    an expert's capacity are dropped (their combine weight is zero) — the
+    standard GShard contract. aux_loss is the Switch load-balancing term.
+    """
+    g, n, d = x.shape
+    e = router_w.shape[-1]
+    capacity = max(1, -(-int(top_k * n * capacity_factor) // e))  # ceil
+
+    x32 = x.astype(jnp.float32)
+    logits = jnp.einsum("gnd,de->gne", x32, router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, masks = _top_k_mask(probs, top_k)  # [G,N,E], [k,G,N,E]
+
+    # Position of each token within its chosen expert's buffer, per slot.
+    # Slot order: all slot-0 picks first, then slot-1 (GShard convention).
+    dispatch = jnp.zeros((g, n, e, capacity), jnp.float32)
+    combine = jnp.zeros((g, n, e, capacity), jnp.float32)
+    prev_count = jnp.zeros((g, 1, e), jnp.float32)
+    for s in range(masks.shape[0]):
+        m = masks[s]  # [G,N,E] one-hot
+        pos = jnp.cumsum(m, axis=1) - m + prev_count  # [G,N,E]
+        keep = m * (pos < capacity)
+        # position of each token within its chosen expert's buffer; value is
+        # only meaningful where keep=1 (dropped tokens are masked out below)
+        pos_idx = (pos * m).sum(-1).astype(jnp.int32)  # [G,N]
+        pos_oh = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+        disp_s = keep[..., None] * pos_oh[:, :, None, :]  # [G,N,E,C]
+        dispatch = dispatch + disp_s
+        combine = combine + disp_s * (gates * m).sum(-1)[..., None, None]
+        prev_count = prev_count + m.sum(1, keepdims=True)
+
+    # Dispatch: [G,N,E,C] x [G,N,D] -> [E,G,C,D]; re-layout E onto `ep`
+    # (XLA inserts the all-to-all between the dp/ep token sharding and the
+    # ep expert sharding).
+    def constrain(arr, spec):
+        if mesh is None:
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    expert_in = jnp.einsum("gnec,gnd->egcd", dispatch.astype(x.dtype), x)
+    expert_in = constrain(expert_in, P("ep", ("dp",), None, None))
+    h = jnp.einsum("egcd,edf->egcf", expert_in, wi.astype(x.dtype))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("egcf,efd->egcd", h, wo.astype(x.dtype))
+    expert_out = constrain(expert_out, P("ep", ("dp",), None, None))
+    out = jnp.einsum("gnec,egcd->gnd", combine.astype(x.dtype), expert_out)
+    out = constrain(out, P(("dp", "ep"), None, None))
+
+    # Switch load-balancing aux: E * sum_e mean_tokens_frac_e * mean_prob_e
+    frac = masks[0].mean(axis=(0, 1))  # fraction routed (slot 0) per expert
+    mean_prob = probs.mean(axis=(0, 1))
+    aux = (frac * mean_prob).sum() * e
+    return out, aux

@@ -1,0 +1,218 @@
+"""Pipeline parallelism: a GPipe schedule over the ``pp`` mesh axis.
+
+The transformer's layer stack is partitioned into ``pp`` contiguous stages
+(the stacked layer params are sharded on their leading L dim by the
+``layers -> pp`` rule, so each device holds L/pp layers). Inside
+``shard_map`` every stage runs the same SPMD program: at schedule tick t,
+stage p applies its layers to microbatch (t - p), then the activation block
+rotates to stage p+1 via ``lax.ppermute`` (one ICI neighbour hop). After
+M + pp - 1 ticks every microbatch has crossed every stage; the last stage
+accumulates the LM loss, which is ``psum``-reduced to every device. The
+whole schedule is a ``lax.scan`` — one compiled XLA program, static control
+flow, differentiable end to end (the backward pipeline is the transposed
+scan with reversed ppermutes, derived by AD — no hand-written 1F1B).
+
+Composes with data parallelism (batch over ``dp``); tensor/sequence/expert
+axes must be 1 inside the pipelined region for now (those compose via GSPMD
+in the non-pipelined path). Reference ships NO pipeline parallelism
+(SURVEY.md §2.5 — Alpa release tests only); this is the native TPU design.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _rms_norm,
+    apply_layer,
+    param_logical_axes,
+    remat_wrap,
+)
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.parallel.mesh import AxisRules, DEFAULT_RULES, logical_to_spec
+from ray_tpu.parallel.train_step import TrainState, batch_sharding
+
+
+def _param_specs(config: TransformerConfig, rules: AxisRules):
+    return jax.tree.map(
+        lambda axes: logical_to_spec(rules, axes),
+        param_logical_axes(config),
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def pipeline_loss_fn(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    rules: AxisRules = DEFAULT_RULES,
+) -> jax.Array:
+    """Drop-in replacement for ``models.transformer.loss_fn`` that runs the
+    layer stack as a pp-stage pipeline. Call inside jit."""
+    c = config
+    pp = mesh.shape["pp"]
+    for ax in ("tp", "sp", "ep"):
+        if mesh.shape[ax] != 1:
+            raise ValueError(
+                f"pipeline_loss_fn requires {ax}=1 (got {mesh.shape[ax]}); "
+                "tp/sp/ep compose via the GSPMD (non-pipelined) path"
+            )
+    if c.n_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={c.n_layers} (equal stages)"
+        )
+    if c.attn_impl != "dense":
+        raise ValueError("pipeline stages use dense attention (sp=1)")
+    M = num_microbatches
+
+    def body(params, tokens, targets, mask):
+        p = lax.axis_index("pp")
+        b, S = tokens.shape  # dp-local batch
+        if b % M:
+            raise ValueError(f"local batch {b} not divisible by {M} microbatches")
+        mb = b // M
+        positions = jnp.arange(S)
+        embed = params["embed"].astype(c.dtype)
+        head = (
+            params["embed"].T if c.tie_embeddings else params["lm_head"]
+        ).astype(c.dtype)
+        final_scale = params["final_ln"]["scale"]
+        layers_local = params["layers"]  # leading dim = n_layers / pp
+
+        toks = tokens.reshape(M, mb, S)
+        tgts = targets.reshape(M, mb, S)
+        msks = mask.reshape(M, mb, S)
+
+        def stage_layers(x):
+            def lyr(carry, lp):
+                y, a = apply_layer(
+                    carry, lp, c, positions, causal_attention, mesh=None
+                )
+                return y, a
+
+            lyr = remat_wrap(lyr, c)
+            x, auxs = lax.scan(lyr, x, layers_local)
+            return x, jnp.sum(auxs)
+
+        def tick(carry, t):
+            state, outs, aux_sum = carry
+            mb_idx = t - p  # which microbatch this stage handles at tick t
+            active = (mb_idx >= 0) & (mb_idx < M)
+            # stage 0 ingests microbatch t from the embedding
+            tok_mb = lax.dynamic_index_in_dim(
+                toks, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(p == 0, embed[tok_mb], state)
+            x_out, aux = stage_layers(x_in)
+            # stash the finished microbatch's activations; scoring happens
+            # ONCE after the schedule (the vocab projection would otherwise
+            # run on every stage at every tick)
+            idx = jnp.clip(mb_idx, 0, M - 1)
+            use = active & (p == pp - 1)
+            cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(use, x_out, cur), idx, 0
+            )
+            aux_sum = aux_sum + jnp.where(active, aux, 0.0)
+            # rotate activations one stage forward (ICI neighbour hop)
+            state = lax.ppermute(
+                x_out, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            return (state, outs, aux_sum), None
+
+        d = c.d_model
+        init = (
+            jnp.zeros((mb, S, d), c.dtype),
+            jnp.zeros((M, mb, S, d), c.dtype),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, outs, aux_sum), _ = lax.scan(
+            tick, init, jnp.arange(M + pp - 1)
+        )
+        # Score all microbatches in one projection. Only the last stage's
+        # buffer holds real outputs; other stages' contributions are masked.
+        xl = _rms_norm(outs.reshape(b, S, d), final_scale)
+        logits = jnp.einsum("bsd,dv->bsv", xl, head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(
+            logp, tgts.reshape(b, S)[..., None], axis=-1
+        )[..., 0]
+        flat_mask = msks.reshape(b, S)
+        is_last = (p == pp - 1).astype(jnp.float32)
+        loss_sum = lax.psum(
+            -(ll * flat_mask).sum() * is_last, ("dp", "pp")
+        )
+        count = lax.psum(flat_mask.sum() * is_last, ("dp", "pp"))
+        ce = loss_sum / jnp.maximum(count, 1.0)
+        if c.moe_experts:
+            aux = lax.psum(aux_sum, ("dp", "pp"))
+            den = c.n_layers * M * mesh.shape["dp"]
+            ce = ce + c.moe_aux_weight * aux / den
+        return ce
+
+    pspecs = _param_specs(c, rules)
+    data_spec = logical_to_spec(rules, ("batch", None))
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(batch["tokens"].shape, jnp.float32)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec, data_spec),
+        out_specs=P(),
+        check_vma=False,
+    )(params, batch["tokens"], batch["targets"], mask)
+
+
+def make_pipeline_train_step(
+    config: TransformerConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    state_shardings: Any,
+    num_microbatches: int,
+    rules: AxisRules = DEFAULT_RULES,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
+    """Pipelined twin of ``train_step.make_train_step`` (same signature)."""
+    data_sh = batch_sharding(mesh, rules)
+
+    loss = partial(
+        pipeline_loss_fn,
+        config=config,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        rules=rules,
+    )
+
+    def step_fn(state: TrainState, batch):
+        loss_val, grads = jax.value_and_grad(
+            lambda p: loss(p, batch)
+        )(state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss_val,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step + 1,
+        }
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    batch_spec = {k: data_sh for k in ("tokens", "targets", "mask")}
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_spec),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
