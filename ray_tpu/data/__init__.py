@@ -1,0 +1,29 @@
+"""ray_tpu.data — the Data-equivalent library.
+
+Block-based lazy datasets executed by a streaming, backpressured executor
+over the task/object plane (parity: reference ``python/ray/data/``; see
+dataset.py / streaming.py for the component mapping). Typical TPU use:
+
+    import ray_tpu.data as rd
+    ds = rd.from_items(samples).map_batches(preprocess)
+    shards = ds.streaming_split(scaling.num_workers)
+    # each JaxTrainer worker:  for batch in shard.iter_batches(...): ...
+"""
+
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    from_items,
+    range,  # noqa: A004 — parity with ray.data.range
+    read_binary_files,
+    read_text,
+)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+
+__all__ = [
+    "Dataset",
+    "DataIterator",
+    "from_items",
+    "range",
+    "read_text",
+    "read_binary_files",
+]

@@ -1,0 +1,201 @@
+"""Dataset: lazy block-based distributed data pipelines.
+
+Parity: reference ``python/ray/data/dataset.py:170`` (Dataset over blocks
+with a lazy plan), ``read_api.py`` sources, ``iterator.py`` consumption and
+``streaming_split`` (``dataset.py:1125``). Blocks are plain Python lists of
+items living in the object store; transforms are remote tasks pipelined by
+the StreamingExecutor (streaming.py) with bounded buffering.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data.streaming import Stage, StreamingExecutor
+
+
+class Dataset:
+    """Lazy pipeline: source block refs + a chain of per-block stages."""
+
+    def __init__(self, source_refs: List, stages: Optional[List[Stage]] = None):
+        self._source_refs = source_refs
+        self._stages = stages or []
+
+    # ---------------- transforms (lazy) ----------------
+
+    def map_batches(
+        self,
+        fn: Callable[[List], List],
+        *,
+        num_cpus: float = 1.0,
+        name: Optional[str] = None,
+    ) -> "Dataset":
+        """fn: block (list of items) -> block. (Reference map_batches with
+        batch == block; use .repartition-by-construction via parallelism.)"""
+        return Dataset(
+            self._source_refs,
+            self._stages + [Stage(name or "map_batches", fn, num_cpus)],
+        )
+
+    def map(self, fn: Callable[[Any], Any], **kw) -> "Dataset":
+        return self.map_batches(
+            lambda block, _fn=fn: [_fn(x) for x in block],
+            name="map", **kw,
+        )
+
+    def filter(self, fn: Callable[[Any], bool], **kw) -> "Dataset":
+        return self.map_batches(
+            lambda block, _fn=fn: [x for x in block if _fn(x)],
+            name="filter", **kw,
+        )
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Block-order + intra-block shuffle (approximate global shuffle;
+        the reference's exact shuffle is push-based — future work)."""
+        import builtins
+        import random as _random
+
+        rng = _random.Random(seed)
+        order = list(builtins.range(len(self._source_refs)))
+        rng.shuffle(order)
+        shuffled = [self._source_refs[i] for i in order]
+        blk_seed = rng.randrange(1 << 30)
+
+        def shuf(block, _s=blk_seed):
+            r = _random.Random(_s + len(block))
+            out = list(block)
+            r.shuffle(out)
+            return out
+
+        return Dataset(shuffled, self._stages + [Stage("shuffle", shuf)])
+
+    # ---------------- execution ----------------
+
+    def _executor(self, **kw) -> StreamingExecutor:
+        return StreamingExecutor(self._stages, self._source_refs, **kw)
+
+    def iter_blocks(self, **kw) -> Iterator[List]:
+        for ref in self._executor(**kw).iter_output_refs():
+            yield ray_tpu.get(ref)
+
+    def iter_rows(self, **kw) -> Iterator[Any]:
+        for block in self.iter_blocks(**kw):
+            yield from block
+
+    def iter_batches(self, batch_size: int = 256, **kw) -> Iterator[List]:
+        buf: List = []
+        for block in self.iter_blocks(**kw):
+            buf.extend(block)
+            while len(buf) >= batch_size:
+                yield buf[:batch_size]
+                buf = buf[batch_size:]
+        if buf:
+            yield buf
+
+    def take(self, n: int = 20) -> List:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(len(b) for b in self.iter_blocks())
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan now; the result is a stage-free Dataset."""
+        refs = list(self._executor().iter_output_refs())
+        return Dataset(refs, [])
+
+    def num_blocks(self) -> int:
+        return len(self._source_refs)
+
+    # ---------------- split ----------------
+
+    def streaming_split(self, n: int) -> List["DataIterator"]:
+        """N per-consumer iterators fed round-robin from ONE streaming
+        execution (reference dataset.py:1125 / stream_split_iterator.py:31).
+        Blocks flow through a coordinator actor so consumers can live in
+        different worker processes (e.g. JaxTrainer workers)."""
+        from ray_tpu.data.iterator import DataIterator, _SplitCoordinator
+
+        import builtins
+
+        coord_cls = ray_tpu.remote(num_cpus=0.1)(_SplitCoordinator)
+        coord = coord_cls.remote(self._source_refs, self._stages, n)
+        return [DataIterator(coord, i) for i in builtins.range(n)]
+
+    def __repr__(self):
+        names = " -> ".join(s.name for s in self._stages) or "source"
+        return f"Dataset({len(self._source_refs)} blocks: {names})"
+
+
+# ---------------- sources (parity: read_api.py) ----------------
+
+def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
+    import builtins
+
+    items = list(items)
+    nblocks = max(1, min(parallelism, len(items) or 1))
+    size = -(-len(items) // nblocks) if items else 1
+    refs = [
+        ray_tpu.put(items[i: i + size])
+        for i in builtins.range(0, len(items), size)
+    ]
+    return Dataset(refs or [ray_tpu.put([])])
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001 — parity
+    import builtins
+
+    per = -(-n // max(1, parallelism))
+    descriptors = [
+        (start, min(start + per, n))
+        for start in builtins.range(0, n, per)
+    ] if n else [(0, 0)]
+    refs = [ray_tpu.put([d]) for d in descriptors]
+
+    def expand(block):
+        out = []
+        for start, end in block:
+            out.extend(builtins.range(start, end))
+        return out
+
+    return Dataset(refs, [Stage("range", expand)])
+
+
+def read_text(paths: List[str], parallelism: int = 8) -> Dataset:
+    """One block per file (line items), read inside tasks (not the driver)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    refs = [ray_tpu.put([p]) for p in paths]
+
+    def load(block):
+        out = []
+        for path in block:
+            with open(path) as f:
+                out.extend(line.rstrip("\n") for line in f)
+        return out
+
+    return Dataset(refs, [Stage("read_text", load)])
+
+
+def read_binary_files(paths: List[str], parallelism: int = 8) -> Dataset:
+    if isinstance(paths, str):
+        paths = [paths]
+    refs = [ray_tpu.put([p]) for p in paths]
+
+    def load(block):
+        out = []
+        for path in block:
+            with open(path, "rb") as f:
+                out.append(f.read())
+        return out
+
+    return Dataset(refs, [Stage("read_binary", load)])

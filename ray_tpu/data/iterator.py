@@ -1,0 +1,78 @@
+"""Per-consumer iterators over a shared streaming execution.
+
+Parity: reference ``python/ray/data/_internal/iterator/stream_split_iterator
+.py:31`` — one StreamingExecutor runs inside a coordinator actor; N
+consumers (JaxTrainer workers, typically in other processes) pull blocks
+round-robin via ``next_block`` RPCs. The executor's bounded buffers mean a
+slow consumer throttles the whole pipeline instead of ballooning memory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional
+
+import ray_tpu
+
+
+class _SplitCoordinator:
+    """Actor: owns the executor, deals blocks round-robin to n splits."""
+
+    def __init__(self, source_refs, stages, n: int):
+        from ray_tpu.data.streaming import StreamingExecutor
+
+        self.n = n
+        self._gen = StreamingExecutor(stages, source_refs).iter_output_refs()
+        self._queues: List[List] = [[] for _ in range(n)]
+        self._rr = 0
+        self._exhausted = False
+
+    def next_block(self, split: int):
+        """Returns the next block (by value) for `split`, or None at end."""
+        while not self._queues[split] and not self._exhausted:
+            try:
+                ref = next(self._gen)
+            except StopIteration:
+                self._exhausted = True
+                break
+            self._queues[self._rr].append(ref)
+            self._rr = (self._rr + 1) % self.n
+        if self._queues[split]:
+            # returning the ref's VALUE keeps the contract simple across
+            # processes (the block travels via the object plane either way)
+            return ray_tpu.get(self._queues[split].pop(0))
+        return None
+
+    def stats(self):
+        return {"queues": [len(q) for q in self._queues],
+                "exhausted": self._exhausted}
+
+
+class DataIterator:
+    """Picklable consumer handle: ships to worker processes."""
+
+    def __init__(self, coordinator, split: int):
+        self._coord = coordinator
+        self._split = split
+
+    def iter_blocks(self) -> Iterator[List]:
+        while True:
+            block = ray_tpu.get(
+                self._coord.next_block.remote(self._split), timeout=300
+            )
+            if block is None:
+                return
+            yield block
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from block
+
+    def iter_batches(self, batch_size: int = 256) -> Iterator[List]:
+        buf: List = []
+        for block in self.iter_blocks():
+            buf.extend(block)
+            while len(buf) >= batch_size:
+                yield buf[:batch_size]
+                buf = buf[batch_size:]
+        if buf:
+            yield buf

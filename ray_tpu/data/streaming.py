@@ -1,0 +1,158 @@
+"""Streaming executor: pipelined block processing with backpressure.
+
+Parity: reference ``python/ray/data/_internal/execution/streaming_executor.py``
+(:49, loop step :217) and the op-state machine
+``streaming_executor_state.py:312,376`` (``select_operator_to_run``). Blocks
+flow between operator stages as ObjectRefs (never materialized on the
+driver); each stage runs remote tasks bounded by ``max_tasks_in_flight``,
+and a stage is only scheduled when downstream buffering is under the limit —
+so a slow consumer bounds cluster memory instead of the pipeline running
+away (the core property the reference spent years on).
+
+TPU shape: the terminal consumer is typically a host feeding
+``jax.device_put`` / ``make_array_from_process_local_data``; keeping the
+object plane as the buffer means host RAM, not HBM, absorbs burstiness.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+
+
+class Stage:
+    """One operator: a per-block transform executed as remote tasks."""
+
+    def __init__(self, name: str, fn: Callable[[List], List],
+                 num_cpus: float = 1.0):
+        self.name = name
+        self.fn = fn
+        self.num_cpus = num_cpus
+
+    def __repr__(self):
+        return f"Stage({self.name})"
+
+
+def _apply_stage_fn(fn, block):
+    return fn(block)
+
+
+class StreamingExecutor:
+    """Pull-based streaming execution of ``stages`` over ``source_blocks``.
+
+    ``max_tasks_in_flight``: per-stage concurrent task cap.
+    ``max_buffered_blocks``: per-stage output-queue cap — the backpressure
+    valve: a stage whose output queue is full is not scheduled.
+    """
+
+    def __init__(
+        self,
+        stages: List[Stage],
+        source_blocks: List[Any],  # ObjectRefs of input blocks
+        max_tasks_in_flight: int = 4,
+        max_buffered_blocks: int = 4,
+    ):
+        self.stages = stages
+        self.max_in_flight = max_tasks_in_flight
+        self.max_buffered = max_buffered_blocks
+        # per-stage state: input queue, in-flight refs, output queue
+        n = len(stages)
+        self._inputs: List[List] = [[] for _ in range(n)]
+        self._inflight: List[Dict] = [dict() for _ in range(n)]  # ref->None
+        self._outputs: List[List] = [[] for _ in range(n)]
+        if n:
+            self._inputs[0] = list(source_blocks)
+        else:
+            self._outputs.append(list(source_blocks))
+        self._source_remaining = 0 if n else len(source_blocks)
+        self._peak_buffered = 0  # observability / tests
+
+    # -- scheduling core (parity: select_operator_to_run) --
+
+    def _schedulable(self, i: int) -> bool:
+        if not self._inputs[i]:
+            return False
+        if len(self._inflight[i]) >= self.max_in_flight:
+            return False
+        # backpressure: this stage's un-consumed output + in-flight must
+        # stay under the buffer cap
+        return (
+            len(self._outputs[i]) + len(self._inflight[i]) < self.max_buffered
+        )
+
+    def _launch(self, i: int):
+        stage = self.stages[i]
+        block_ref = self._inputs[i].pop(0)
+        task = ray_tpu.remote(num_cpus=stage.num_cpus)(_apply_stage_fn)
+        out_ref = task.remote(stage.fn, block_ref)
+        self._inflight[i][out_ref] = None
+
+    def _pump(self, timeout: float = 0.2) -> bool:
+        """One loop step: launch what's schedulable, harvest what finished.
+        Returns True if anything might still move."""
+        launched = False
+        # Prefer downstream stages (drain before filling; reference's
+        # select_operator_to_run ranks by downstream memory usage).
+        for i in reversed(range(len(self.stages))):
+            while self._schedulable(i):
+                self._launch(i)
+                launched = True
+        all_inflight = [r for infl in self._inflight for r in infl]
+        if all_inflight:
+            ready, _ = ray_tpu.wait(
+                all_inflight,
+                num_returns=1,
+                timeout=None if launched else timeout,
+                fetch_local=False,
+            )
+            for r in ready:
+                for i, infl in enumerate(self._inflight):
+                    if r in infl:
+                        del infl[r]
+                        self._outputs[i].append(r)
+                        break
+        buffered = sum(len(q) for q in self._outputs) + sum(
+            len(f) for f in self._inflight
+        )
+        self._peak_buffered = max(self._peak_buffered, buffered)
+        return bool(all_inflight or launched)
+
+    # -- consumption --
+
+    def _wire(self):
+        """Move finished blocks downstream — but only while the downstream
+        stage is under its buffer cap, so backpressure propagates upstream
+        (a full stage j stalls stage j-1's scheduling via its output queue)."""
+        for i in range(len(self.stages) - 1):
+            j = i + 1
+            while self._outputs[i] and (
+                len(self._inputs[j])
+                + len(self._inflight[j])
+                + len(self._outputs[j])
+                < self.max_buffered
+            ):
+                self._inputs[j].append(self._outputs[i].pop(0))
+
+    def _done(self) -> bool:
+        return not any(self._inputs) and not any(
+            self._inflight
+        )
+
+    def iter_output_refs(self) -> Iterator[Any]:
+        """Yield final-stage block refs as they materialize (streaming)."""
+        if not self.stages:
+            yield from self._outputs[-1]
+            return
+        last = len(self.stages) - 1
+        while True:
+            self._wire()
+            while self._outputs[last]:
+                yield self._outputs[last].pop(0)
+            if self._done():
+                self._wire()
+                while self._outputs[last]:
+                    yield self._outputs[last].pop(0)
+                return
+            self._pump()

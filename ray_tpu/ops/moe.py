@@ -54,9 +54,11 @@ def moe_ffn(
     an expert's capacity are dropped (their combine weight is zero) — the
     standard GShard contract. aux_loss is the Switch load-balancing term.
     """
+    import math
+
     g, n, d = x.shape
     e = router_w.shape[-1]
-    capacity = max(1, -(-int(top_k * n * capacity_factor) // e))  # ceil
+    capacity = max(1, math.ceil(top_k * n * capacity_factor / e))
 
     x32 = x.astype(jnp.float32)
     logits = jnp.einsum("gnd,de->gne", x32, router_w.astype(jnp.float32))

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.models.transformer import (
     TransformerConfig,
@@ -38,7 +38,7 @@ from ray_tpu.models.transformer import (
 )
 from ray_tpu.ops.attention import causal_attention
 from ray_tpu.parallel.mesh import AxisRules, DEFAULT_RULES, logical_to_spec
-from ray_tpu.parallel.train_step import TrainState, batch_sharding
+from ray_tpu.parallel.train_step import TrainState
 
 
 def _param_specs(config: TransformerConfig, rules: AxisRules):
@@ -183,36 +183,16 @@ def make_pipeline_train_step(
     num_microbatches: int,
     rules: AxisRules = DEFAULT_RULES,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
-    """Pipelined twin of ``train_step.make_train_step`` (same signature)."""
-    data_sh = batch_sharding(mesh, rules)
+    """Pipelined twin of ``train_step.make_train_step``: same step contract,
+    with the pipeline schedule plugged in as the loss."""
+    from ray_tpu.parallel.train_step import make_train_step
 
-    loss = partial(
-        pipeline_loss_fn,
-        config=config,
-        mesh=mesh,
-        num_microbatches=num_microbatches,
+    return make_train_step(
+        config,
+        mesh,
+        optimizer,
+        state_shardings,
         rules=rules,
-    )
-
-    def step_fn(state: TrainState, batch):
-        loss_val, grads = jax.value_and_grad(
-            lambda p: loss(p, batch)
-        )(state.params)
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": loss_val,
-            "grad_norm": optax.global_norm(grads),
-            "step": state.step + 1,
-        }
-        return TrainState(state.step + 1, params, opt_state), metrics
-
-    batch_spec = {k: data_sh for k in ("tokens", "targets", "mask")}
-    return jax.jit(
-        step_fn,
-        in_shardings=(state_shardings, batch_spec),
-        out_shardings=(state_shardings, NamedSharding(mesh, P())),
-        donate_argnums=(0,),
+        loss=partial(pipeline_loss_fn, num_microbatches=num_microbatches,
+                     rules=rules),
     )

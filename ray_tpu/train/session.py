@@ -25,6 +25,7 @@ class TrainContext:
     world_size: int
     experiment_name: str
     mesh_config: Optional[Any] = None  # parallel.MeshConfig
+    dataset_shards: Optional[Dict[str, Any]] = None  # name -> DataIterator
 
 
 class _TrainSession:
@@ -96,6 +97,18 @@ def get_world_rank() -> int:
 
 def get_world_size() -> int:
     return _get_session().context.world_size
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming shard of ``JaxTrainer(datasets={name: ds})``
+    (parity: ray.train session.get_dataset_shard / ``get_dataset_shard:958``).
+    Returns a ``ray_tpu.data.DataIterator``."""
+    shards = _get_session().context.dataset_shards or {}
+    if name not in shards:
+        raise KeyError(
+            f"no dataset {name!r} was passed to JaxTrainer(datasets=...)"
+        )
+    return shards[name]
 
 
 def make_mesh(mesh_config=None):

@@ -106,12 +106,22 @@ class JaxTrainer:
         )
         try:
             group.bootstrap_distributed()
+            # One streaming execution per dataset, split across the workers
+            # (blocks flow worker-side through the coordinator actor).
+            shard_lists = {
+                name: ds.streaming_split(sc.num_workers)
+                for name, ds in self.datasets.items()
+            }
             contexts = [
                 TrainContext(
                     world_rank=i,
                     world_size=sc.num_workers,
                     experiment_name=os.path.basename(self.experiment_path),
                     mesh_config=sc.mesh,
+                    dataset_shards={
+                        name: shards[i]
+                        for name, shards in shard_lists.items()
+                    },
                 )
                 for i in range(sc.num_workers)
             ]

@@ -1,0 +1,145 @@
+"""Data-equivalent tests: streaming executor, transforms, split, trainer feed.
+
+Parity surfaces: reference ``python/ray/data/tests/`` — lazy transforms,
+streaming execution with bounded buffering (the backpressure state machine,
+``streaming_executor_state.py:312,376``), ``streaming_split`` feeding train
+workers.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture
+def rt_data():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_from_items_roundtrip(rt_data):
+    ds = rd.from_items(list(range(100)), parallelism=8)
+    assert ds.num_blocks() == 8
+    assert sorted(ds.take_all()) == list(range(100))
+    assert ds.count() == 100
+
+
+def test_range_map_filter(rt_data):
+    ds = rd.range(50, parallelism=4).map(lambda x: x * 2).filter(
+        lambda x: x % 4 == 0
+    )
+    out = sorted(ds.take_all())
+    assert out == [x * 2 for x in range(50) if (x * 2) % 4 == 0]
+
+
+def test_map_batches_block_level(rt_data):
+    ds = rd.from_items(list(range(20)), parallelism=4).map_batches(
+        lambda block: [sum(block)]
+    )
+    per_block_sums = sorted(ds.take_all())
+    assert sum(per_block_sums) == sum(range(20))
+    assert len(per_block_sums) == 4
+
+
+def test_iter_batches_sizes(rt_data):
+    ds = rd.from_items(list(range(23)), parallelism=3)
+    batches = list(ds.iter_batches(batch_size=10))
+    assert [len(b) for b in batches] == [10, 10, 3]
+
+
+def test_take_is_streaming(rt_data):
+    """take(5) must not execute the whole pipeline."""
+    ds = rd.from_items(list(range(1000)), parallelism=100)
+    ex = ds._executor()
+    got = []
+    for ref in ex.iter_output_refs():
+        got.extend(ray_tpu.get(ref))
+        if len(got) >= 5:
+            break
+    # far fewer than all 100 blocks were pulled through
+    assert ex._peak_buffered <= 10
+
+
+def test_backpressure_bounds_buffering(rt_data):
+    """A slow consumer keeps in-flight + buffered blocks under the cap."""
+    ds = rd.from_items(list(range(64)), parallelism=16).map_batches(
+        lambda b: b
+    )
+    ex = ds._executor(max_tasks_in_flight=2, max_buffered_blocks=3)
+    seen = 0
+    for _ref in ex.iter_output_refs():
+        time.sleep(0.05)  # slow consumer
+        seen += 1
+    assert seen == 16
+    # cap is per-stage (1 stage here): inflight+outputs <= 3, plus the
+    # harvest slack of one pump round
+    assert ex._peak_buffered <= 4, ex._peak_buffered
+
+
+def test_random_shuffle(rt_data):
+    ds = rd.from_items(list(range(200)), parallelism=8).random_shuffle(seed=7)
+    out = ds.take_all()
+    assert sorted(out) == list(range(200))
+    assert out != list(range(200))  # astronomically unlikely to be identity
+
+
+def test_streaming_split_disjoint_and_complete(rt_data):
+    ds = rd.from_items(list(range(60)), parallelism=6).map(lambda x: x)
+    a, b = ds.streaming_split(2)
+    got_a = list(a.iter_rows())
+    got_b = list(b.iter_rows())
+    assert sorted(got_a + got_b) == list(range(60))
+    assert got_a and got_b  # both consumers got data
+
+
+def test_streaming_split_feeds_trainer(rt_data, tmp_path):
+    """Ingest pipeline feeds JaxTrainer workers without materializing the
+    dataset on the driver (BASELINE 'data ingest -> trainer' shape)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rd.from_items(
+        [{"x": float(i), "y": 2.0 * i} for i in range(40)], parallelism=8
+    ).map(lambda r: {"x": r["x"], "y": r["y"]})
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        shard = session.get_dataset_shard("train")
+        n = 0
+        total = 0.0
+        for batch in shard.iter_batches(batch_size=5):
+            n += len(batch)
+            total += sum(r["y"] for r in batch)
+        session.report({"rows": n, "total": total})
+
+    class Sum2(JaxTrainer):
+        rows = {}
+
+        def _drain(self, group):
+            done = [False] * group.num_workers
+            last = {}
+            while not all(done):
+                for rank, p in enumerate(group.poll_all(timeout=10.0)):
+                    for ev in p["events"]:
+                        Sum2.rows[rank] = ev["metrics"]
+                        last = ev["metrics"]
+                    if p["done"]:
+                        if p["error"] is not None:
+                            raise RuntimeError(p.get("error_tb"))
+                        done[rank] = True
+            return last
+
+    Sum2(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="data_feed", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    ).fit()
+    assert sum(m["rows"] for m in Sum2.rows.values()) == 40
+    assert sum(m["total"] for m in Sum2.rows.values()) == sum(
+        2.0 * i for i in range(40)
+    )
