@@ -9,11 +9,24 @@ the StreamingExecutor (streaming.py) with bounded buffering.
 
 from __future__ import annotations
 
-import itertools
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.data.streaming import Stage, StreamingExecutor
+
+
+def batches_from_blocks(block_iter: Iterator[List],
+                        batch_size: int) -> Iterator[List]:
+    """Re-chunk a stream of blocks into fixed-size batches (tail partial).
+    Shared by Dataset.iter_batches and DataIterator.iter_batches."""
+    buf: List = []
+    for block in block_iter:
+        buf.extend(block)
+        while len(buf) >= batch_size:
+            yield buf[:batch_size]
+            buf = buf[batch_size:]
+    if buf:
+        yield buf
 
 
 class Dataset:
@@ -63,13 +76,17 @@ class Dataset:
         shuffled = [self._source_refs[i] for i in order]
         blk_seed = rng.randrange(1 << 30)
 
-        def shuf(block, _s=blk_seed):
-            r = _random.Random(_s + len(block))
+        def shuf(block, idx, _s=blk_seed):
+            # distinct permutation per block: seed mixes the block index
+            r = _random.Random(_s * 1000003 + idx)
             out = list(block)
             r.shuffle(out)
             return out
 
-        return Dataset(shuffled, self._stages + [Stage("shuffle", shuf)])
+        return Dataset(
+            shuffled,
+            self._stages + [Stage("shuffle", shuf, with_index=True)],
+        )
 
     # ---------------- execution ----------------
 
@@ -85,14 +102,7 @@ class Dataset:
             yield from block
 
     def iter_batches(self, batch_size: int = 256, **kw) -> Iterator[List]:
-        buf: List = []
-        for block in self.iter_blocks(**kw):
-            buf.extend(block)
-            while len(buf) >= batch_size:
-                yield buf[:batch_size]
-                buf = buf[batch_size:]
-        if buf:
-            yield buf
+        return batches_from_blocks(self.iter_blocks(**kw), batch_size)
 
     def take(self, n: int = 20) -> List:
         out = []
@@ -170,11 +180,23 @@ def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001 — parity
     return Dataset(refs, [Stage("range", expand)])
 
 
-def read_text(paths: List[str], parallelism: int = 8) -> Dataset:
-    """One block per file (line items), read inside tasks (not the driver)."""
+def _path_blocks(paths, parallelism: int) -> List:
+    """Group files into ~parallelism path-list blocks (file granularity —
+    single files are not byte-range split)."""
+    import builtins
+
     if isinstance(paths, str):
         paths = [paths]
-    refs = [ray_tpu.put([p]) for p in paths]
+    nblocks = max(1, min(parallelism, len(paths) or 1))
+    per = -(-len(paths) // nblocks)
+    return [
+        ray_tpu.put(paths[i: i + per])
+        for i in builtins.range(0, len(paths), per)
+    ] or [ray_tpu.put([])]
+
+
+def read_text(paths: List[str], parallelism: int = 8) -> Dataset:
+    """Line items; files are opened inside tasks (not the driver)."""
 
     def load(block):
         out = []
@@ -183,14 +205,11 @@ def read_text(paths: List[str], parallelism: int = 8) -> Dataset:
                 out.extend(line.rstrip("\n") for line in f)
         return out
 
-    return Dataset(refs, [Stage("read_text", load)])
+    return Dataset(_path_blocks(paths, parallelism),
+                   [Stage("read_text", load)])
 
 
 def read_binary_files(paths: List[str], parallelism: int = 8) -> Dataset:
-    if isinstance(paths, str):
-        paths = [paths]
-    refs = [ray_tpu.put([p]) for p in paths]
-
     def load(block):
         out = []
         for path in block:
@@ -198,4 +217,5 @@ def read_binary_files(paths: List[str], parallelism: int = 8) -> Dataset:
                 out.append(f.read())
         return out
 
-    return Dataset(refs, [Stage("read_binary", load)])
+    return Dataset(_path_blocks(paths, parallelism),
+                   [Stage("read_binary", load)])

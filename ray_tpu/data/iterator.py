@@ -14,6 +14,16 @@ from typing import Any, Iterator, List, Optional
 import ray_tpu
 
 
+# Sentinel telling a consumer to back off and re-poll: the pipeline cannot
+# advance without overflowing a slower split's bounded queue.
+_RETRY = "__raytpu_split_retry__"
+
+# Per-split buffered-block cap: bounds coordinator-side memory to
+# n_splits * cap blocks even when one consumer stalls (the stall then
+# backpressures every split, which backpressures the executor itself).
+_SPLIT_QUEUE_CAP = 4
+
+
 class _SplitCoordinator:
     """Actor: owns the executor, deals blocks round-robin to n splits."""
 
@@ -27,8 +37,11 @@ class _SplitCoordinator:
         self._exhausted = False
 
     def next_block(self, split: int):
-        """Returns the next block (by value) for `split`, or None at end."""
+        """Next block (by value) for ``split``; None at end of data; the
+        _RETRY sentinel when a slower split's full queue blocks progress."""
         while not self._queues[split] and not self._exhausted:
+            if len(self._queues[self._rr]) >= _SPLIT_QUEUE_CAP:
+                return _RETRY  # round-robin target is full: wait for it
             try:
                 ref = next(self._gen)
             except StopIteration:
@@ -37,9 +50,11 @@ class _SplitCoordinator:
             self._queues[self._rr].append(ref)
             self._rr = (self._rr + 1) % self.n
         if self._queues[split]:
-            # returning the ref's VALUE keeps the contract simple across
-            # processes (the block travels via the object plane either way)
-            return ray_tpu.get(self._queues[split].pop(0))
+            # return the REF (inside a list so the reply is a ref-bearing
+            # value, not an auto-resolved task arg): the block then moves
+            # producer->consumer over the object plane exactly once, instead
+            # of being funneled by value through this actor
+            return [self._queues[split].pop(0)]
         return None
 
     def stats(self):
@@ -48,31 +63,45 @@ class _SplitCoordinator:
 
 
 class DataIterator:
-    """Picklable consumer handle: ships to worker processes."""
+    """Picklable consumer handle: ships to worker processes.
 
-    def __init__(self, coordinator, split: int):
+    ``timeout`` (seconds) bounds each next_block RPC; None = wait forever
+    (slow stages are a pipeline property, not a failure)."""
+
+    def __init__(self, coordinator, split: int,
+                 timeout: Optional[float] = None):
         self._coord = coordinator
         self._split = split
+        self._timeout = timeout
 
     def iter_blocks(self) -> Iterator[List]:
+        import time as _time
+
         while True:
-            block = ray_tpu.get(
-                self._coord.next_block.remote(self._split), timeout=300
+            reply = ray_tpu.get(
+                self._coord.next_block.remote(self._split),
+                timeout=self._timeout,
             )
-            if block is None:
+            if reply is None:
                 return
-            yield block
+            if isinstance(reply, str) and reply == _RETRY:
+                _time.sleep(0.1)  # a slower split's queue gates progress
+                continue
+            yield ray_tpu.get(reply[0], timeout=self._timeout)
+
+    def stop(self):
+        """Kill the shared coordinator actor (call once per split group,
+        e.g. when a trainer attempt ends)."""
+        try:
+            ray_tpu.kill(self._coord)
+        except Exception:
+            pass
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
             yield from block
 
     def iter_batches(self, batch_size: int = 256) -> Iterator[List]:
-        buf: List = []
-        for block in self.iter_blocks():
-            buf.extend(block)
-            while len(buf) >= batch_size:
-                yield buf[:batch_size]
-                buf = buf[batch_size:]
-        if buf:
-            yield buf
+        from ray_tpu.data.dataset import batches_from_blocks
+
+        return batches_from_blocks(self.iter_blocks(), batch_size)

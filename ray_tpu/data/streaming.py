@@ -16,27 +16,31 @@ object plane as the buffer means host RAM, not HBM, absorbs burstiness.
 
 from __future__ import annotations
 
-import time
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List
 
 import ray_tpu
 
 
 class Stage:
-    """One operator: a per-block transform executed as remote tasks."""
+    """One operator: a per-block transform executed as remote tasks.
 
-    def __init__(self, name: str, fn: Callable[[List], List],
-                 num_cpus: float = 1.0):
+    ``with_index=True`` passes the block's pipeline position as a second
+    argument (stages are 1:1 per block, so the index is stable end-to-end) —
+    used e.g. to derive distinct per-block shuffle seeds."""
+
+    def __init__(self, name: str, fn: Callable, num_cpus: float = 1.0,
+                 with_index: bool = False):
         self.name = name
         self.fn = fn
         self.num_cpus = num_cpus
+        self.with_index = with_index
 
     def __repr__(self):
         return f"Stage({self.name})"
 
 
-def _apply_stage_fn(fn, block):
-    return fn(block)
+def _apply_stage_fn(fn, with_index, idx, block):
+    return fn(block, idx) if with_index else fn(block)
 
 
 class StreamingExecutor:
@@ -57,16 +61,17 @@ class StreamingExecutor:
         self.stages = stages
         self.max_in_flight = max_tasks_in_flight
         self.max_buffered = max_buffered_blocks
-        # per-stage state: input queue, in-flight refs, output queue
+        # per-stage state: input queue, in-flight refs, output queue.
+        # queue entries are (block_index, ref) pairs; the index is stable
+        # through the 1:1 stages.
         n = len(stages)
         self._inputs: List[List] = [[] for _ in range(n)]
-        self._inflight: List[Dict] = [dict() for _ in range(n)]  # ref->None
+        self._inflight: List[Dict] = [dict() for _ in range(n)]  # ref->idx
         self._outputs: List[List] = [[] for _ in range(n)]
         if n:
-            self._inputs[0] = list(source_blocks)
+            self._inputs[0] = list(enumerate(source_blocks))
         else:
-            self._outputs.append(list(source_blocks))
-        self._source_remaining = 0 if n else len(source_blocks)
+            self._outputs.append(list(enumerate(source_blocks)))
         self._peak_buffered = 0  # observability / tests
 
     # -- scheduling core (parity: select_operator_to_run) --
@@ -84,10 +89,10 @@ class StreamingExecutor:
 
     def _launch(self, i: int):
         stage = self.stages[i]
-        block_ref = self._inputs[i].pop(0)
+        idx, block_ref = self._inputs[i].pop(0)
         task = ray_tpu.remote(num_cpus=stage.num_cpus)(_apply_stage_fn)
-        out_ref = task.remote(stage.fn, block_ref)
-        self._inflight[i][out_ref] = None
+        out_ref = task.remote(stage.fn, stage.with_index, idx, block_ref)
+        self._inflight[i][out_ref] = idx
 
     def _pump(self, timeout: float = 0.2) -> bool:
         """One loop step: launch what's schedulable, harvest what finished.
@@ -110,8 +115,7 @@ class StreamingExecutor:
             for r in ready:
                 for i, infl in enumerate(self._inflight):
                     if r in infl:
-                        del infl[r]
-                        self._outputs[i].append(r)
+                        self._outputs[i].append((infl.pop(r), r))
                         break
         buffered = sum(len(q) for q in self._outputs) + sum(
             len(f) for f in self._inflight
@@ -136,23 +140,26 @@ class StreamingExecutor:
                 self._inputs[j].append(self._outputs[i].pop(0))
 
     def _done(self) -> bool:
-        return not any(self._inputs) and not any(
-            self._inflight
+        # Mid-stage outputs still count as pending work: declaring done while
+        # a block sits in an intermediate output queue (downstream at cap)
+        # would silently drop it.
+        return (
+            not any(self._inputs)
+            and not any(self._inflight)
+            and not any(self._outputs[:-1])
         )
 
     def iter_output_refs(self) -> Iterator[Any]:
         """Yield final-stage block refs as they materialize (streaming)."""
         if not self.stages:
-            yield from self._outputs[-1]
+            for _idx, ref in self._outputs[-1]:
+                yield ref
             return
         last = len(self.stages) - 1
         while True:
             self._wire()
             while self._outputs[last]:
-                yield self._outputs[last].pop(0)
+                yield self._outputs[last].pop(0)[1]
             if self._done():
-                self._wire()
-                while self._outputs[last]:
-                    yield self._outputs[last].pop(0)
                 return
             self._pump()

@@ -1,0 +1,15 @@
+"""ray_tpu.rllib — the RLlib-equivalent (sampling actors + JAX learner).
+
+    from ray_tpu.rllib import PPOConfig
+    algo = PPOConfig(env="CartPole-v1", num_workers=2).build()
+    while algo.train()["episode_reward_mean"] < 450:
+        ...
+
+Parity: reference ``rllib/algorithms/ppo/``; sampling plane =
+``rollout_worker.py`` env-runner actors, learning plane = a jitted JAX
+actor-critic update (ppo.py).
+"""
+
+from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+
+__all__ = ["PPO", "PPOConfig"]

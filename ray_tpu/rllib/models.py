@@ -1,0 +1,60 @@
+"""Policy/value networks for the RLlib-equivalent (pure-functional JAX).
+
+Parity role: reference ``rllib/core/rl_module/rl_module.py:229`` (the
+policy+value module abstraction) specialized to an MLP actor-critic —
+enough for the BASELINE PPO workloads; the model is a pytree + apply
+function so the learner can jit/pjit it like any other ray_tpu model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _mlp_params(rng, dims, head_dim, head_scale):
+    keys = jax.random.split(rng, len(dims))
+    layers = []
+    for i in range(len(dims) - 1):
+        layers.append({
+            "w": jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+            * (2.0 / dims[i]) ** 0.5,
+            "b": jnp.zeros((dims[i + 1],)),
+        })
+    layers.append({
+        "w": jax.random.normal(keys[-1], (dims[-1], head_dim)) * head_scale,
+        "b": jnp.zeros((head_dim,)),
+    })
+    return layers
+
+
+def _mlp_apply(layers, x):
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+def init_actor_critic(
+    rng: jax.Array,
+    obs_dim: int,
+    num_actions: int,
+    hidden: Sequence[int] = (64, 64),
+) -> Dict:
+    """Separate actor and critic towers (reference PPO default,
+    vf_share_layers=False — a shared trunk lets the large value-loss
+    gradients distort the policy)."""
+    k_pi, k_vf = jax.random.split(rng)
+    dims = [obs_dim, *hidden]
+    return {
+        "pi": _mlp_params(k_pi, dims, num_actions, 0.01),
+        "vf": _mlp_params(k_vf, dims, 1, 1.0),
+    }
+
+
+def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    logits = _mlp_apply(params["pi"], obs)
+    value = _mlp_apply(params["vf"], obs)[..., 0]
+    return logits, value

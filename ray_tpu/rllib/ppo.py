@@ -1,0 +1,185 @@
+"""PPO: synchronous sampling fan-out + a jitted JAX learner.
+
+Parity: reference ``rllib/algorithms/ppo/ppo.py:420`` (``training_step``:
+synchronous_parallel_sample over the WorkerSet → GAE → minibatch SGD) and
+``core/learner/learner.py:229``. TPU shape: the learner's clipped-surrogate
+update is ONE jitted program (minibatch SGD epochs via ``lax.scan``) that
+runs on the accelerator with a device mesh when available; rollouts come
+from host env-runner actors (rollout_worker.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_workers: int = 2
+    rollout_len: int = 512  # per worker per iteration
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip: float = 0.2
+    lr: float = 3e-4
+    sgd_epochs: int = 8
+    minibatch: int = 256
+    entropy_coef: float = 0.01
+    vf_coef: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """``algo = PPOConfig(...).build(); algo.train()`` — each train() call is
+    one sampling+SGD iteration returning reference-shaped result metrics."""
+
+    def __init__(self, config: PPOConfig):
+        import gymnasium
+        import jax
+        import optax
+
+        self.config = config
+        probe = gymnasium.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.params = init_actor_critic(
+            jax.random.key(config.seed), obs_dim, num_actions, config.hidden
+        )
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._make_update())
+        self._rng = jax.random.key(config.seed + 1)
+
+        worker_cls = ray_tpu.remote(num_cpus=1)(RolloutWorker)
+        self.workers = [
+            worker_cls.remote(
+                config.env, config.rollout_len, config.gamma, config.lam,
+                seed=config.seed + 1000 * (i + 1),
+            )
+            for i in range(config.num_workers)
+        ]
+        self._iter = 0
+        self._recent_returns: List[float] = []
+
+    # ------------------------------------------------------------------
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+
+        def loss_fn(params, batch):
+            logits, value = apply_actor_critic(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - c.clip, 1 + c.clip) * adv,
+            ).mean()
+            vf = ((value - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg + c.vf_coef * vf - c.entropy_coef * entropy
+            return total, {"policy_loss": pg, "vf_loss": vf,
+                           "entropy": entropy}
+
+        def update(params, opt_state, rng, batch):
+            n = batch["obs"].shape[0]
+            mb_size = min(c.minibatch, n)
+            nmb = max(1, n // mb_size)
+
+            def epoch(carry, key):
+                params, opt_state = carry
+                perm = jax.random.permutation(key, n)
+
+                def mb_step(carry, idx):
+                    params, opt_state = carry
+                    mb = jax.tree.map(
+                        lambda x: x[idx], batch
+                    )
+                    (_, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params, mb)
+                    updates, opt_state = self.opt.update(grads, opt_state)
+                    import optax as _optax
+
+                    params = _optax.apply_updates(params, updates)
+                    return (params, opt_state), aux
+
+                idxs = perm[: nmb * mb_size].reshape(nmb, mb_size)
+                (params, opt_state), auxs = jax.lax.scan(
+                    mb_step, (params, opt_state), idxs
+                )
+                return (params, opt_state), auxs
+
+            keys = jax.random.split(rng, c.sgd_epochs)
+            (params, opt_state), auxs = jax.lax.scan(
+                epoch, (params, opt_state), keys
+            )
+            last_aux = jax.tree.map(lambda x: x[-1, -1], auxs)
+            return params, opt_state, last_aux
+
+        return update
+
+    # ------------------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration (parity: Algorithm.train / PPO.training_step)."""
+        import jax
+
+        self._iter += 1
+        # synchronous parallel sample (weights broadcast via the object plane)
+        params_ref = ray_tpu.put(jax.device_get(self.params))
+        batches = ray_tpu.get(
+            [w.sample.remote(params_ref) for w in self.workers], timeout=600
+        )
+        batch = {
+            k: np.concatenate([b[k] for b in batches])
+            for k in ("obs", "actions", "logp", "advantages", "returns")
+        }
+        for b in batches:
+            self._recent_returns.extend(b["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+        # advantage normalization (standard PPO practice)
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, sub, batch
+        )
+        return {
+            "training_iteration": self._iter,
+            "episode_reward_mean": (
+                float(np.mean(self._recent_returns))
+                if self._recent_returns else float("nan")
+            ),
+            "num_env_steps_sampled": (
+                self._iter * self.config.num_workers * self.config.rollout_len
+            ),
+            "info": {k: float(v) for k, v in aux.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,117 @@
+"""Env-runner actor: collects on-policy rollouts with GAE post-processing.
+
+Parity: reference ``rllib/evaluation/rollout_worker.py:159`` (``sample():660``
+→ ``sampler.py`` env loop) plus the GAE postprocessor
+(``evaluation/postprocessing.py:158``). TPU split: env stepping and the
+tiny per-step policy forward stay on host CPU inside these actors; only the
+learner's batched update runs on accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class RolloutWorker:
+    """Actor body. One (gymnasium) env per worker; ``sample(params)`` runs
+    ``rollout_len`` steps with the given policy and returns a GAE-processed
+    train batch of numpy arrays."""
+
+    def __init__(self, env_name: str, rollout_len: int, gamma: float,
+                 lam: float, seed: int = 0):
+        import os
+
+        # keep env-runner JAX on host CPU (the learner owns the accelerator).
+        # Must happen before the backend initializes — querying
+        # jax.default_backend() first would itself commit the TPU backend.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import gymnasium
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized (fresh workers never are)
+        self.env = gymnasium.make(env_name)
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.lam = lam
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+
+        from ray_tpu.rllib.models import apply_actor_critic
+
+        self._apply = jax.jit(apply_actor_critic)
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp  # noqa: F401 — jax already configured
+
+        T = self.rollout_len
+        obs_buf = np.zeros((T, *np.shape(self.obs)), np.float32)
+        act_buf = np.zeros((T,), np.int32)
+        logp_buf = np.zeros((T,), np.float32)
+        val_buf = np.zeros((T,), np.float32)
+        rew_buf = np.zeros((T,), np.float32)
+        term_buf = np.zeros((T,), np.float32)  # true termination: V(next)=0
+        cut_buf = np.zeros((T,), np.float32)  # episode boundary: cut GAE
+        next_val = np.zeros((T,), np.float32)  # V(s_{t+1}) within-episode
+
+        for t in range(T):
+            logits, value = self._apply(params, self.obs[None].astype(np.float32))
+            logits = np.asarray(logits[0], np.float64)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            action = int(self.rng.choice(len(p), p=p))
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.log(p[action] + 1e-12)
+            val_buf[t] = float(value[0])
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            rew_buf[t] = reward
+            self._episode_return += float(reward)
+            term_buf[t] = float(terminated)
+            cut_buf[t] = float(terminated or truncated)
+            if truncated and not terminated:
+                # bootstrap the truncated episode with V of its real final
+                # state — NOT the next episode's first state
+                _, bv = self._apply(params, nxt[None].astype(np.float32))
+                next_val[t] = float(bv[0])
+            if terminated or truncated:
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                nxt, _ = self.env.reset()
+            self.obs = nxt
+            if t > 0 and cut_buf[t - 1] == 0.0:
+                next_val[t - 1] = val_buf[t]
+
+        # bootstrap value for the final (possibly mid-episode) state
+        if cut_buf[T - 1] == 0.0:
+            _, last_v = self._apply(params, self.obs[None].astype(np.float32))
+            next_val[T - 1] = float(last_v[0])
+
+        adv = np.zeros((T,), np.float32)
+        last_gae = 0.0
+        for t in reversed(range(T)):
+            delta = (
+                rew_buf[t]
+                + self.gamma * next_val[t] * (1.0 - term_buf[t])
+                - val_buf[t]
+            )
+            last_gae = (
+                delta + self.gamma * self.lam * (1.0 - cut_buf[t]) * last_gae
+            )
+            adv[t] = last_gae
+        returns = adv + val_buf
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "advantages": adv,
+            "returns": returns,
+            "episode_returns": np.asarray(completed, np.float32),
+        }

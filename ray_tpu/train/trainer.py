@@ -104,6 +104,7 @@ class JaxTrainer:
             devices_per_worker=sc.devices_per_worker,
             placement_strategy=sc.placement_strategy,
         )
+        shard_lists = {}
         try:
             group.bootstrap_distributed()
             # One streaming execution per dataset, split across the workers
@@ -134,6 +135,9 @@ class JaxTrainer:
             )
             return self._drain(group)
         finally:
+            for shards in shard_lists.values():
+                if shards:
+                    shards[0].stop()  # reap the split coordinator actor
             group.shutdown()
 
     def _drain(self, group: WorkerGroup) -> Dict:

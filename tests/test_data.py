@@ -53,7 +53,9 @@ def test_iter_batches_sizes(rt_data):
 
 def test_take_is_streaming(rt_data):
     """take(5) must not execute the whole pipeline."""
-    ds = rd.from_items(list(range(1000)), parallelism=100)
+    ds = rd.from_items(list(range(1000)), parallelism=100).map_batches(
+        lambda b: b
+    )
     ex = ds._executor()
     got = []
     for ref in ex.iter_output_refs():

@@ -1,0 +1,59 @@
+"""RLlib-equivalent tests: PPO on CartPole-v1 (BASELINE config #1).
+
+Parity surface: reference ``rllib/algorithms/ppo/tests/test_ppo.py`` — the
+algorithm learns CartPole through env-runner actors + the JAX learner.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+
+@pytest.fixture
+def rt_rl():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_rollout_worker_batch_shapes():
+    from ray_tpu.rllib.models import init_actor_critic
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    import jax
+
+    w = RolloutWorker("CartPole-v1", rollout_len=64, gamma=0.99, lam=0.95,
+                      seed=3)
+    params = init_actor_critic(jax.random.key(0), 4, 2)
+    b = w.sample(params)
+    assert b["obs"].shape == (64, 4)
+    assert b["actions"].shape == (64,)
+    assert np.isfinite(b["advantages"]).all()
+    # returns = advantages + values => finite and correlated with rewards
+    assert np.isfinite(b["returns"]).all()
+
+
+def test_ppo_cartpole_reaches_450(rt_rl):
+    algo = PPOConfig(
+        env="CartPole-v1",
+        num_workers=2,
+        rollout_len=1024,
+        sgd_epochs=10,
+        minibatch=256,
+        lr=1e-3,
+        seed=0,
+    ).build()
+    best = -np.inf
+    try:
+        for _ in range(80):
+            result = algo.train()
+            mean = result["episode_reward_mean"]
+            if np.isfinite(mean):
+                best = max(best, mean)
+            if best >= 450:
+                break
+        assert best >= 450, f"PPO plateaued at {best}"
+    finally:
+        algo.stop()
