@@ -100,8 +100,6 @@ _d("task_events_enabled", bool, True)
 _d("metrics_report_interval_ms", int, 2000)
 _d("object_spilling_enabled", bool, True)
 _d("object_spilling_threshold", float, 0.8)
-_d("gcs_storage_backend", str, "memory")  # "memory" | "file"
 _d("log_to_driver", bool, True)
 # --- tpu ---
-_d("tpu_mesh_bootstrap_timeout_s", float, 120.0)
-_d("tpu_donate_buffers", bool, True)
+_d("tpu_mesh_bootstrap_timeout_s", float, 300.0)

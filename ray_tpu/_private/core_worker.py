@@ -363,6 +363,14 @@ class CoreWorker:
                     timeout=10,
                 )
             )
+            # Lifetime parity for the disk copy: if the raylet spilled this
+            # object, its file must die with the last reference too.
+            if GLOBAL_CONFIG.object_spilling_enabled:
+                self.io.submit(
+                    self.raylet.conn.call_async(
+                        "delete_spilled", oid.binary(), timeout=10
+                    )
+                )
         except Exception:
             pass
 
@@ -378,15 +386,44 @@ class CoreWorker:
             self._handoff_pins.popleft()
 
     # ================= serialization helpers =================
+    def _create_with_spill(self, oid: ObjectID, total: int):
+        """Allocate in the store; on FULL, escalate to the raylet's spill
+        path (which moves sealed LRU objects to disk) and retry — the
+        reference create-request-queue + LocalObjectManager interplay
+        (create_request_queue.h / local_object_manager.h:41)."""
+        deadline = time.monotonic() + 30.0
+        zero_streak = 0
+        while True:
+            try:
+                return self.store.create_buffer(oid, total)
+            except StoreFullError:
+                if not GLOBAL_CONFIG.object_spilling_enabled:
+                    raise exc.OutOfMemoryError(
+                        f"object store full putting {total} bytes for "
+                        f"{oid.hex()} (spilling disabled)"
+                    )
+                try:
+                    freed = self.raylet.call("spill_now", total, timeout=30)
+                except Exception:
+                    freed = 0
+                # freed == 0 does NOT mean no space appeared: a concurrent
+                # spiller (the memory monitor, another client) may have
+                # taken the candidates — always retry the create, and only
+                # give up after several barren rounds.
+                zero_streak = 0 if freed else zero_streak + 1
+                if zero_streak >= 3 or time.monotonic() > deadline:
+                    raise exc.OutOfMemoryError(
+                        f"object store full putting {total} bytes for "
+                        f"{oid.hex()}; spilling freed nothing (all objects "
+                        f"pinned or in flight)"
+                    )
+                if not freed:
+                    time.sleep(0.05)  # let the concurrent spiller finish
+
     def _write_to_store(self, oid: ObjectID, value) -> None:
         """Serialize + seal into the local shared-memory store (no GCS I/O)."""
         meta, views, total = serialization.packed_size(value)
-        try:
-            buf = self.store.create_buffer(oid, total)
-        except StoreFullError:
-            raise exc.OutOfMemoryError(
-                f"object store full putting {total} bytes for {oid.hex()}"
-            )
+        buf = self._create_with_spill(oid, total)
         try:
             serialization.pack_into(meta, views, buf)
         finally:
@@ -1331,7 +1368,7 @@ class CoreWorker:
                 ]
                 self._pin_handoff(contained)
             if total > GLOBAL_CONFIG.inline_object_max_bytes:
-                buf = self.store.create_buffer(oid, total)
+                buf = self._create_with_spill(oid, total)
                 try:
                     serialization.pack_into(meta, views, buf)
                 finally:

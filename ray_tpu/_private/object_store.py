@@ -74,6 +74,13 @@ def _load():
             lib.rt_store_stats.argtypes = [ctypes.c_void_p] + [
                 ctypes.POINTER(ctypes.c_uint64)
             ] * 4
+            lib.rt_store_evictable.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ]
+            lib.rt_store_evictable.restype = ctypes.c_int64
+            lib.rt_store_set_no_evict.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+            ]
             _lib = lib
         return _lib
 
@@ -149,6 +156,8 @@ class SharedMemoryStore:
             raise StoreFullError(f"object store full allocating {size} bytes")
         if off == -2:
             raise ObjectExistsError(oid.hex())
+        if off == -3:
+            raise StoreFullError("object table full (too many objects)")
         if off < 0:
             raise RuntimeError(f"store create failed rc={off}")
         return self._view[off : off + size]
@@ -202,6 +211,20 @@ class SharedMemoryStore:
         if not self._base:
             return False
         return bool(self._lib.rt_store_contains(self._base, oid.binary()))
+
+    def set_no_evict(self, enabled: bool):
+        """Disable silent LRU eviction on full creates (spilling mode: the
+        raylet preserves bytes on disk instead of dropping them)."""
+        if self._base:
+            self._lib.rt_store_set_no_evict(self._base, int(enabled))
+
+    def evictable(self, max_n: int = 256) -> list:
+        """Sealed refcount-0 ObjectIDs in LRU order (spill candidates)."""
+        if not self._base:
+            return []
+        buf = ctypes.create_string_buffer(16 * max_n)
+        n = self._lib.rt_store_evictable(self._base, buf, max_n)
+        return [ObjectID(buf.raw[i * 16 : (i + 1) * 16]) for i in range(n)]
 
     def stats(self) -> dict:
         if not self._base:

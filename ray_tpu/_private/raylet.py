@@ -107,6 +107,13 @@ class Raylet:
         self.pg_prepare_ttl: Dict[bytes, Any] = {}  # pg_id -> TimerHandle
         self.pg_bundle_total: Dict[bytes, Dict[int, Dict[str, float]]] = {}
         self.pg_bundle_avail: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        # Object spilling (parity: local_object_manager.h:41 +
+        # external_storage.py FileSystemStorage): sealed LRU objects move to
+        # disk under memory pressure and restore on demand.
+        self.spill_dir = os.path.join(session_dir, "spill",
+                                      node_id.hex()[:12])
+        self.spilled: Dict[bytes, str] = {}  # oid -> file path
+        self.spilled_bytes = 0
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -114,6 +121,9 @@ class Raylet:
     async def start(self):
         size = int(GLOBAL_CONFIG.object_store_memory_bytes)
         self.store = SharedMemoryStore.create(self.store_path, size)
+        if GLOBAL_CONFIG.object_spilling_enabled:
+            # full creates escalate to spill_now instead of dropping LRU data
+            self.store.set_no_evict(True)
         await self.server.start_async()
         self.gcs = await self._connect_gcs()
         reply = await self.gcs.call_async(
@@ -135,6 +145,7 @@ class Raylet:
         self.cluster_resources = snap.get("resources") or {}
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
+        self._tasks.append(loop.create_task(self._memory_monitor_loop()))
         if GLOBAL_CONFIG.prestart_workers:
             n = int(self.total_resources.get("CPU", 1))
             n = min(n, max(1, (os.cpu_count() or 4)))
@@ -885,6 +896,195 @@ class Raylet:
             w.proc.kill()
         return True
 
+    # ------------- memory monitor: spilling + OOM -------------
+    # Parity: reference MemoryMonitor (memory_monitor.h:52) + LocalObjectManager
+    # spilling (local_object_manager.h:41) + worker-killing policy
+    # (worker_killing_policy_retriable_fifo.h).
+
+    def _host_memory_fraction(self) -> float:
+        fake_file = os.environ.get("RAYTPU_FAKE_MEM_USAGE_FILE")
+        if fake_file:  # fault-injection hook (reference chaos-test style):
+            try:  # the file's content is the fake usage fraction
+                with open(fake_file) as f:
+                    return float(f.read().strip() or 0.0)
+            except OSError:
+                return 0.0
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0])
+            avail = info.get("MemAvailable", info.get("MemFree", 0))
+            total = info.get("MemTotal", 1)
+            return 1.0 - avail / total
+        except Exception:
+            return 0.0
+
+    async def _memory_monitor_loop(self):
+        period = GLOBAL_CONFIG.memory_monitor_refresh_ms / 1e3
+        while not self._stopping:
+            await asyncio.sleep(period)
+            try:
+                if GLOBAL_CONFIG.object_spilling_enabled:
+                    await self._maybe_spill()
+                self._maybe_kill_for_oom()
+            except Exception:
+                logger.exception("memory monitor iteration failed")
+
+    async def _maybe_spill(self):
+        st = self.store.stats()
+        if not st["arena_size"]:
+            return
+        threshold = GLOBAL_CONFIG.object_spilling_threshold
+        usage = st["bytes_allocated"] / st["arena_size"]
+        if usage <= threshold:
+            return
+        target = threshold * 0.9 * st["arena_size"]
+        for oid in self.store.evictable(max_n=256):
+            if st["bytes_allocated"] <= target:
+                break
+            spilled = await self._spill_object(oid)
+            if spilled:
+                st = self.store.stats()
+
+    async def _spill_object(self, oid) -> bool:
+        view = self.store.get(oid, timeout=0)
+        if view is None:
+            return False
+        loop = asyncio.get_running_loop()
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, oid.hex())
+            tmp = path + f".tmp.{os.getpid()}"
+
+            def write():  # disk I/O off the event loop (heartbeats keep
+                with open(tmp, "wb") as f:  # flowing during GB-scale spills)
+                    f.write(view)
+                os.replace(tmp, path)
+
+            await loop.run_in_executor(None, write)
+        finally:
+            view.release()
+            self.store.release(oid)
+        self.spilled[oid.binary()] = path
+        self.spilled_bytes += os.path.getsize(path)
+        self.store.delete(oid)  # refcount-safe: deferred if pinned
+        logger.info("spilled %s (%d bytes on disk)", oid.hex()[:12],
+                    self.spilled_bytes)
+        return True
+
+    async def _restore_object(self, oid) -> bool:
+        """Bring a spilled object back into the store (get-path demand)."""
+        path = self.spilled.get(oid.binary())
+        if path is None:
+            return False
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(
+                None, lambda: open(path, "rb").read()
+            )
+        except FileNotFoundError:
+            self.spilled.pop(oid.binary(), None)
+            return False
+        buf = await self._create_local_with_spill(oid, len(data))
+        if buf is None:
+            return self.store.contains(oid)  # racer may have restored it
+        buf[:] = data
+        del buf
+        self.store.seal(oid)
+        self.store.release(oid)
+        self.spilled.pop(oid.binary(), None)
+        self.spilled_bytes = max(0, self.spilled_bytes - len(data))
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return True
+
+    async def _create_local_with_spill(self, oid, size: int):
+        """create_buffer that escalates to spilling OTHER objects on FULL
+        (the raylet-side twin of core_worker._create_with_spill). Returns
+        None when space cannot be made."""
+        from ray_tpu._private.object_store import StoreFullError
+
+        for _ in range(8):
+            try:
+                return self.store.create_buffer(oid, size)
+            except StoreFullError:
+                freed = 0
+                for cand in self.store.evictable(max_n=64):
+                    if cand.binary() == oid.binary():
+                        continue
+                    before = self.store.stats()["bytes_allocated"]
+                    if await self._spill_object(cand):
+                        freed += before - self.store.stats()["bytes_allocated"]
+                    if freed >= size:
+                        break
+                if not freed:
+                    return None
+            except Exception:
+                return None  # e.g. ObjectExists: concurrent restore won
+        return None
+
+    async def rpc_delete_spilled(self, conn, oid_bytes: bytes):
+        """Owner freed the object: drop its spill file (lifetime parity with
+        the in-store copy)."""
+        path = self.spilled.pop(oid_bytes, None)
+        if path is None:
+            return False
+        try:
+            size = os.path.getsize(path)
+            os.unlink(path)
+            self.spilled_bytes = max(0, self.spilled_bytes - size)
+        except OSError:
+            pass
+        return True
+
+    async def rpc_spill_now(self, conn, bytes_needed: int):
+        """Synchronous spill request from a client whose create hit FULL:
+        spill LRU objects until >= bytes_needed are free (or no candidates).
+        Returns bytes freed."""
+        freed = 0
+        for oid in self.store.evictable(max_n=256):
+            if freed >= int(bytes_needed) * 2:  # headroom: halve retry loops
+                break
+            before = self.store.stats()["bytes_allocated"]
+            if await self._spill_object(oid):
+                freed += before - self.store.stats()["bytes_allocated"]
+        return freed
+
+    def _maybe_kill_for_oom(self):
+        threshold = GLOBAL_CONFIG.memory_usage_threshold
+        if threshold >= 1.0 or self._host_memory_fraction() < threshold:
+            return
+        now = time.monotonic()
+        # Cooldown: give the previous kill time to actually release memory
+        # before deciding again (otherwise every leased worker dies within
+        # one pressure spike).
+        if now - getattr(self, "_last_oom_kill", 0.0) < 1.0:
+            return
+        # Retriable-FIFO policy: kill the most recently leased *task* worker
+        # (its task retries; older tasks keep their progress). Actor workers
+        # are exempt — killing one is permanent with max_restarts=0, which
+        # "task will retry" cannot justify (reference group-by-owner policy
+        # territory).
+        newest = None
+        for lease in self.leases.values():
+            if lease.worker.proc is None or lease.worker.actor_id is not None:
+                continue
+            if newest is None or lease.granted_at > newest.granted_at:
+                newest = lease
+        if newest is not None and newest.worker.proc.poll() is None:
+            self._last_oom_kill = now
+            logger.warning(
+                "memory pressure %.0f%% >= %.0f%%: killing worker %s "
+                "(task will retry)",
+                self._host_memory_fraction() * 100, threshold * 100,
+                newest.worker.worker_id.hex()[:6],
+            )
+            newest.worker.proc.kill()
+
     # ------------- object plane -------------
     async def rpc_pull_object(self, conn, oid_bytes: bytes):
         """Ensure the object is in the local store (fetch from a remote node).
@@ -896,6 +1096,8 @@ class Raylet:
 
         oid = ObjectID(oid_bytes)
         if self.store.contains(oid):
+            return True
+        if await self._restore_object(oid):  # spilled here: restore from disk
             return True
         locs = await self.gcs.call_async("get_object_locations", oid_bytes)
         for node_id in locs:
@@ -924,7 +1126,9 @@ class Raylet:
                     return False
                 size = meta["size"]
                 chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
-                buf = self.store.create_buffer(oid, size)
+                buf = await self._create_local_with_spill(oid, size)
+                if buf is None:
+                    return self.store.contains(oid)
                 try:
                     for off in range(0, size, chunk):
                         n = min(chunk, size - off)
@@ -956,6 +1160,8 @@ class Raylet:
         from ray_tpu._private.ids import ObjectID
 
         view = self.store.get(ObjectID(oid_bytes), timeout=0)
+        if view is None and await self._restore_object(ObjectID(oid_bytes)):
+            view = self.store.get(ObjectID(oid_bytes), timeout=0)
         if view is None:
             return None
         size = view.nbytes
@@ -969,6 +1175,8 @@ class Raylet:
         oid_bytes, off, n = data
         oid = ObjectID(oid_bytes)
         view = self.store.get(oid, timeout=0)
+        if view is None and await self._restore_object(oid):
+            view = self.store.get(oid, timeout=0)
         if view is None:
             return None
         try:

@@ -203,10 +203,13 @@ class WorkerGroup:
 
     def bootstrap_distributed(self) -> List[Dict[str, int]]:
         """Assemble the global JAX world across all workers (barrier)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        timeout = GLOBAL_CONFIG.tpu_mesh_bootstrap_timeout_s
         if self.num_workers == 1:
             return ray_tpu.get(
                 [self.workers[0].setup_distributed.remote("", 1, 0)],
-                timeout=300,
+                timeout=timeout,
             )
         coordinator = ray_tpu.get(
             self.workers[0].coordinator_info.remote(), timeout=60
@@ -216,7 +219,7 @@ class WorkerGroup:
                 w.setup_distributed.remote(coordinator, self.num_workers, i)
                 for i, w in enumerate(self.workers)
             ],
-            timeout=300,
+            timeout=timeout,
         )
 
     def start_training(self, train_fn, train_loop_config, contexts,

@@ -80,6 +80,8 @@ struct Header {
   uint64_t num_objects;
   uint64_t num_evictions;
   uint64_t seq_counter;
+  // 1 = creates never auto-evict (raylet spills to disk instead)
+  uint64_t no_evict;
 };
 
 // ---- arena block ----
@@ -372,19 +374,24 @@ int rt_store_detach(void* base) {
 
 // Allocate an object slot. Returns data offset (from region base) or:
 //  -1 = out of memory (even after eviction), -2 = already exists, -3 = table full
+// When eviction is disabled (spilling mode: the raylet preserves bytes on
+// disk instead of dropping them), a full arena fails the create with -1 and
+// the caller escalates to the raylet's spill path.
 int64_t rt_store_create(void* base, const uint8_t* id, uint64_t data_size) {
   Header* h = H(base);
   lock(h);
   Entry* existing = find_entry(base, id, false);
   if (existing && existing->state != ENTRY_TOMBSTONE) { unlock(h); return -2; }
   int64_t off = arena_alloc(base, data_size ? data_size : 1);
-  while (off < 0 && evict_one(base)) {
+  while (off < 0 && !h->no_evict && evict_one(base)) {
     off = arena_alloc(base, data_size ? data_size : 1);
   }
   if (off < 0) { unlock(h); return -1; }
   Entry* e = find_entry(base, id, true);
-  // Table full: evict LRU objects (tombstoning their slots) to make room.
-  while (!e && evict_one(base)) {
+  // Table full: evict LRU objects (tombstoning their slots) to make room —
+  // unless spilling owns eviction (no_evict), where dropping un-spilled
+  // sealed data would violate the durability contract: fail instead.
+  while (!e && !h->no_evict && evict_one(base)) {
     e = find_entry(base, id, true);
   }
   if (!e) { arena_free(base, off); unlock(h); return -3; }
@@ -483,6 +490,32 @@ int rt_store_contains(void* base, const uint8_t* id) {
   int r = (e && e->state == ENTRY_SEALED) ? 1 : 0;
   unlock(h);
   return r;
+}
+
+void rt_store_set_no_evict(void* base, int enabled) {
+  Header* h = H(base);
+  lock(h);
+  h->no_evict = enabled ? 1 : 0;
+  unlock(h);
+}
+
+// List spill/eviction candidates: sealed refcount-0 objects in LRU order
+// (least-recent first). Copies up to max_n 16-byte ids into out; returns the
+// count. Used by the raylet's spill policy (reference: the plasma eviction
+// policy feeding local_object_manager.h:41 spilling).
+int64_t rt_store_evictable(void* base, uint8_t* out, uint64_t max_n) {
+  Header* h = H(base);
+  lock(h);
+  int64_t n = 0;
+  int64_t idx = h->lru_head;
+  while (idx >= 0 && (uint64_t)n < max_n) {
+    Entry* e = &table(base)[idx];
+    memcpy(out + n * 16, e->id, 16);
+    n++;
+    idx = e->lru_next;
+  }
+  unlock(h);
+  return n;
 }
 
 void rt_store_stats(void* base, uint64_t* bytes_allocated, uint64_t* arena_size,

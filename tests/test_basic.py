@@ -160,25 +160,35 @@ def test_is_initialized(rt):
     assert ray_tpu.is_initialized()
 
 
-def test_zero_copy_view_pinned_against_eviction(rt):
+def test_zero_copy_view_pinned_against_eviction():
     """A gotten array's bytes must survive store pressure: the deserialized
     view pins the object's store refcount until the array dies (ADVICE r1:
-    LRU eviction could reuse the block under a live numpy view)."""
+    LRU eviction could reuse the block under a live numpy view). Runs with
+    spilling disabled to exercise the raw eviction path."""
     import ray_tpu as rt_mod
     from ray_tpu._private.worker import global_worker
 
     store_bytes = 128 * 1024 * 1024
-    n = (store_bytes // 8) // 8  # each array ~1/8 of the store
-    ref = rt_mod.put(np.full(n, 7, dtype=np.int64))
-    arr = rt_mod.get(ref)
-    assert arr.flags["OWNDATA"] is False  # genuinely zero-copy
-    # Drop our ref so only the pinned view protects the bytes, then flood.
-    del ref
-    floods = [rt_mod.put(np.zeros(n, dtype=np.int64)) for _ in range(12)]
-    stats = global_worker.core_worker.store.stats()
-    assert stats["num_evictions"] > 0, "pressure never triggered eviction"
-    assert int(arr[0]) == 7 and int(arr[-1]) == 7 and int(arr.sum()) == 7 * n
-    del floods
+    rt_mod.init(
+        num_cpus=4,
+        object_store_memory=store_bytes,
+        system_config={"object_spilling_enabled": False},
+    )
+    try:
+        n = (store_bytes // 8) // 8  # each array ~1/8 of the store
+        ref = rt_mod.put(np.full(n, 7, dtype=np.int64))
+        arr = rt_mod.get(ref)
+        assert arr.flags["OWNDATA"] is False  # genuinely zero-copy
+        # Drop our ref so only the pinned view protects the bytes; flood.
+        del ref
+        floods = [rt_mod.put(np.zeros(n, dtype=np.int64)) for _ in range(12)]
+        stats = global_worker.core_worker.store.stats()
+        assert stats["num_evictions"] > 0, "pressure never triggered eviction"
+        assert int(arr[0]) == 7 and int(arr[-1]) == 7
+        assert int(arr.sum()) == 7 * n
+        del floods
+    finally:
+        rt_mod.shutdown()
 
 
 def test_wait_on_borrowed_ref(rt):
