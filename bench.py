@@ -105,6 +105,22 @@ def main():
         long_ctx = None
         metric = "train_step_mfu_tiny_cpu"
 
+    # Core-runtime microbenchmarks (reference ray_perf.py — the canonical
+    # perf regression gate, SURVEY §4) — fast subset.
+    try:
+        import ray_tpu
+        from ray_tpu._private.ray_perf import run_microbenchmarks
+
+        ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+        try:
+            micro = run_microbenchmarks(
+                tasks_n=100, actor_calls_n=200, put_mb=16, put_n=5
+            )
+        finally:
+            ray_tpu.shutdown()
+    except Exception as e:  # the MFU headline must survive a micro failure
+        micro = {"error": str(e)[:160]}
+
     out = {
         "metric": metric,
         "value": round(mfu, 4),
@@ -117,6 +133,7 @@ def main():
             "tokens_per_s": round(tps, 1),
             "attn_impl": cfg.attn_impl,
             "long_ctx": long_ctx,
+            "micro": micro,
         },
     }
     print(json.dumps(out))

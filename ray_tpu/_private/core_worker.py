@@ -239,6 +239,10 @@ class CoreWorker:
         self._actor_id: Optional[bytes] = None
         self._current_task_name = ""
         self._shutdown = threading.Event()
+        # task-event buffer (batched to the GCS task manager)
+        self._task_events: List[Dict] = []
+        self._task_event_lock = threading.Lock()
+        self._task_events_flushed = time.monotonic()
 
         install_ref_hooks(self._on_ref_created, self._on_ref_deleted)
 
@@ -255,6 +259,19 @@ class CoreWorker:
                 os._exit(1)
 
             self.raylet.conn.on_close = _raylet_gone
+        if mode == MODE_DRIVER and GLOBAL_CONFIG.log_to_driver:
+            # Receive worker stdout/stderr lines (log monitor pipeline).
+            try:
+                self.gcs.call("subscribe", ["logs"])
+            except Exception:
+                pass
+        if GLOBAL_CONFIG.task_events_enabled:
+            async def _event_flusher():
+                while not self._shutdown.is_set():
+                    await asyncio.sleep(1.0)
+                    self._flush_task_events()
+
+            self.io.submit(_event_flusher())
 
     # ================= reference counting =================
     def _on_ref_created(self, ref: ObjectRef):
@@ -308,6 +325,19 @@ class CoreWorker:
             logger.debug("borrow %s notify failed for %s: %s",
                          "add" if add else "remove", ref.hex()[:12], e)
 
+    async def rpc_publish(self, conn, data):
+        """GCS pubsub push. Drivers print forwarded worker log lines
+        (parity: ray's log monitor -> driver stream)."""
+        channel, payload = data
+        if channel == "logs" and self.mode == MODE_DRIVER:
+            import sys
+
+            for entry in payload:
+                tag = f"({entry['worker'][:8]}, {entry['node'][:8]})"
+                for line in entry["lines"]:
+                    print(f"{tag} {line}", file=sys.stderr)
+        return True
+
     async def rpc_add_borrower(self, conn, data):
         oid_bytes, borrower_id = data
         oid = ObjectID(bytes(oid_bytes))
@@ -357,20 +387,12 @@ class CoreWorker:
         except Exception:
             pass
         try:
+            # Single RPC: the GCS fans the free out to every node holding a
+            # copy (in-store or spilled) and drops the location entry.
             self.io.submit(
-                self.gcs.conn.call_async(
-                    "remove_object_location", [oid.binary(), self.node_id],
-                    timeout=10,
-                )
+                self.gcs.conn.call_async("free_object", oid.binary(),
+                                         timeout=10)
             )
-            # Lifetime parity for the disk copy: if the raylet spilled this
-            # object, its file must die with the last reference too.
-            if GLOBAL_CONFIG.object_spilling_enabled:
-                self.io.submit(
-                    self.raylet.conn.call_async(
-                        "delete_spilled", oid.binary(), timeout=10
-                    )
-                )
         except Exception:
             pass
 
@@ -421,9 +443,47 @@ class CoreWorker:
                     time.sleep(0.05)  # let the concurrent spiller finish
 
     def _write_to_store(self, oid: ObjectID, value) -> None:
-        """Serialize + seal into the local shared-memory store (no GCS I/O)."""
+        """Serialize + seal into the local shared-memory store (no GCS I/O).
+        Compute-thread variant — never call from the IO loop (the spill
+        escalation uses the sync RPC facade)."""
         meta, views, total = serialization.packed_size(value)
         buf = self._create_with_spill(oid, total)
+        try:
+            serialization.pack_into(meta, views, buf)
+        finally:
+            del buf
+        self.store.seal(oid)
+        self.store.release(oid)
+
+    async def _write_to_store_async(self, oid: ObjectID, value) -> None:
+        """IO-loop twin of _write_to_store: spill escalation via await."""
+        meta, views, total = serialization.packed_size(value)
+        zero_streak = 0
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                buf = self.store.create_buffer(oid, total)
+                break
+            except StoreFullError:
+                if not GLOBAL_CONFIG.object_spilling_enabled:
+                    raise exc.OutOfMemoryError(
+                        f"object store full putting {total} bytes for "
+                        f"{oid.hex()} (spilling disabled)"
+                    )
+                try:
+                    freed = await self.raylet.conn.call_async(
+                        "spill_now", total, timeout=30
+                    )
+                except Exception:
+                    freed = 0
+                zero_streak = 0 if freed else zero_streak + 1
+                if zero_streak >= 3 or time.monotonic() > deadline:
+                    raise exc.OutOfMemoryError(
+                        f"object store full putting {total} bytes for "
+                        f"{oid.hex()}; spilling freed nothing"
+                    )
+                if not freed:
+                    await asyncio.sleep(0.05)
         try:
             serialization.pack_into(meta, views, buf)
         finally:
@@ -762,8 +822,52 @@ class CoreWorker:
             "retries_left": spec.max_retries,
             "pinned": pinned or [],
         }
+        self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
         self.io.submit(self._submit_async(spec))
         return refs
+
+    # ================= task events (observability) =================
+    # Parity: reference TaskEventBuffer (task_event_buffer.h:199) batching
+    # per-task state transitions to the GCS task manager (gcs_task_manager
+    # .h:61) — powers `ray_tpu status` / list_tasks / timeline().
+
+    def _emit_task_event(self, spec, state: str, error: str = ""):
+        if not GLOBAL_CONFIG.task_events_enabled:
+            return
+        name = spec.name if not spec.method_name else (
+            f"{spec.name}.{spec.method_name}"
+        )
+        ev = {
+            "task_id": spec.task_id,
+            "name": name,
+            "state": state,
+            "ts": time.time(),
+            "node": self.node_id,
+            "worker": self.worker_id,
+            "actor_id": spec.actor_id,
+            "error": error,
+        }
+        with self._task_event_lock:
+            self._task_events.append(ev)
+            flush_due = (
+                len(self._task_events) >= 64
+                or time.monotonic() - self._task_events_flushed > 1.0
+            )
+        if flush_due:
+            self._flush_task_events()
+
+    def _flush_task_events(self):
+        with self._task_event_lock:
+            batch, self._task_events = self._task_events, []
+            self._task_events_flushed = time.monotonic()
+        if not batch:
+            return
+        try:
+            self.io.submit(
+                self.gcs.conn.call_async("add_task_events", batch, timeout=10)
+            )
+        except Exception:
+            pass  # observability is best-effort
 
     @staticmethod
     def _freeze(v):
@@ -829,9 +933,10 @@ class CoreWorker:
                 if len(packed) <= GLOBAL_CONFIG.inline_object_max_bytes:
                     spec.args[i] = ["v", packed]
                 else:
-                    # NOTE: runs on the IO loop — must use the async GCS call
-                    # (the sync facade would deadlock the loop, ADVICE r1).
-                    self._write_to_store(oid, e.value)
+                    # NOTE: runs on the IO loop — must use async RPC variants
+                    # throughout (the sync facades would deadlock the loop:
+                    # ADVICE r1, and the spill escalation likewise).
+                    await self._write_to_store_async(oid, e.value)
                     await self.gcs.conn.call_async(
                         "add_object_location", [oid.binary(), self.node_id]
                     )
@@ -1098,6 +1203,7 @@ class CoreWorker:
         self._pending_tasks[spec.task_id] = {
             "spec": spec, "retries_left": 0, "pinned": pinned or [],
         }
+        self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
         self.io.submit(self._enqueue_actor_task(spec))
         return refs
 
@@ -1306,6 +1412,7 @@ class CoreWorker:
 
     def _execute(self, spec: TaskSpec) -> Dict:
         self._current_task_name = spec.name
+        self._emit_task_event(spec, "RUNNING")
         try:
             if spec.actor_creation:
                 cls_info = self._fetch("cls", spec.function_id, spec.job_id)
@@ -1324,9 +1431,12 @@ class CoreWorker:
                 fn = self._fetch("fn", spec.function_id, spec.job_id)
                 args, kwargs = self._unpack_args(self._decode_args(spec))
                 result = fn(*args, **kwargs)
-            return self._encode_returns(spec, result)
+            out = self._encode_returns(spec, result)
+            self._emit_task_event(spec, "FINISHED")
+            return out
         except Exception as e:
             tb = traceback.format_exc()
+            self._emit_task_event(spec, "FAILED", error=str(e))
             err = exc.TaskError(
                 function_name=spec.name, traceback_str=tb, cause=None
             )

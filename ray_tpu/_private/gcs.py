@@ -26,6 +26,21 @@ from ray_tpu._private.protocol import NodeInfo, TaskSpec
 
 logger = logging.getLogger(__name__)
 
+# Tie-break order when state timestamps collide (within one attempt the
+# transitions happen fast enough to share a clock tick).
+_STATE_ORDER = ["PENDING_NODE_ASSIGNMENT", "RUNNING", "FINISHED", "FAILED"]
+
+
+def _latest_state(rec: Dict) -> str:
+    if not rec["states"]:
+        return "UNKNOWN"
+    return max(
+        rec["states"].items(),
+        key=lambda kv: (kv[1], _STATE_ORDER.index(kv[0])
+                        if kv[0] in _STATE_ORDER else -1),
+    )[0]
+
+
 # Actor FSM states (parity: rpc::ActorTableData::ActorState)
 PENDING = "PENDING_CREATION"
 ALIVE = "ALIVE"
@@ -105,6 +120,7 @@ class GcsServer:
         self.named_actors: Dict[str, bytes] = {}
         self.placement_groups: Dict[bytes, PgRecord] = {}
         self.jobs: Dict[bytes, Dict] = {}
+        self.task_events: Dict[bytes, Dict] = {}  # insertion-ordered
         # pubsub: channel -> set of connections
         self.subs: Dict[str, Set[rpc.Connection]] = {}
         self._raylet_clients: Dict[bytes, rpc.Connection] = {}
@@ -692,6 +708,84 @@ class GcsServer:
     async def rpc_get_object_locations(self, conn, oid):
         locs = self.kv.get("loc:" + oid.hex())
         return rpc.msgpack.unpackb(locs) if locs else []
+
+    async def rpc_free_object(self, conn, oid_bytes: bytes):
+        """Owner freed its last reference: delete every copy — in-store AND
+        spilled — on every node that holds one (parity: reference
+        FreeObjects fan-out). One RPC from the owner; the GCS fans out only
+        to copy-holding raylets."""
+        key = "loc:" + oid_bytes.hex()
+        locs = self.kv.pop(key, None)
+        nodes = (
+            [bytes(n) for n in rpc.msgpack.unpackb(locs)] if locs else []
+        )
+        for nid in nodes:
+            raylet = self._raylet_clients.get(nid)
+            if raylet is not None and not raylet.closed:
+                asyncio.get_running_loop().create_task(
+                    raylet.call_async("free_local_object", oid_bytes,
+                                      timeout=10)
+                )
+        return True
+
+    # ---------------- task events (observability) ----------------
+    # Parity: reference GcsTaskManager (gcs_task_manager.h:61) — the sink
+    # for worker TaskEventBuffers; powers list_tasks/summary/timeline.
+
+    MAX_TASK_RECORDS = 10000
+
+    async def rpc_add_task_events(self, conn, batch: List[Dict]):
+        for ev in batch:
+            tid = bytes(ev["task_id"])
+            rec = self.task_events.get(tid)
+            if rec is None:
+                if len(self.task_events) >= self.MAX_TASK_RECORDS:
+                    # drop oldest record (insertion order ~ submission order)
+                    self.task_events.pop(next(iter(self.task_events)))
+                rec = {
+                    "task_id": tid,
+                    "name": ev.get("name") or "",
+                    "actor_id": ev.get("actor_id"),
+                    "states": {},
+                    "node": None,
+                    "worker": None,
+                    "error": "",
+                    "attempts": 0,
+                }
+                self.task_events[tid] = rec
+            state = ev["state"]
+            if state == "RUNNING":
+                rec["attempts"] += 1
+                rec["node"] = ev.get("node")
+                rec["worker"] = ev.get("worker")
+                # a retry attempt supersedes the previous terminal state
+                rec["states"].pop("FINISHED", None)
+                rec["states"].pop("FAILED", None)
+            rec["states"][state] = ev["ts"]
+            if ev.get("error"):
+                rec["error"] = ev["error"]
+        return True
+
+    async def rpc_list_task_events(self, conn, filters: Optional[Dict]):
+        filters = filters or {}
+        limit = int(filters.get("limit") or 1000)
+        out = []
+        for rec in reversed(list(self.task_events.values())):
+            if len(out) >= limit:
+                break
+            if filters.get("name") and filters["name"] not in rec["name"]:
+                continue
+            state = _latest_state(rec)
+            if filters.get("state") and filters["state"] != state:
+                continue
+            out.append(dict(rec, state=state))
+        return out
+
+    async def rpc_publish_logs(self, conn, batch):
+        """Raylet log monitors forward worker stdout/stderr; fan out to
+        subscribed drivers (reference log monitor -> driver, services.py:971)."""
+        self._publish("logs", batch)
+        return True
 
     # ---------------- debug ----------------
     async def rpc_ping(self, conn, _):

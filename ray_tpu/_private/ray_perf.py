@@ -1,0 +1,120 @@
+"""Core microbenchmarks — the perf regression floor.
+
+Parity: reference ``python/ray/_private/ray_perf.py:93`` (single/multi
+client task, actor-call, and put/get throughput timers — the canonical
+core-perf gate run nightly). Run directly::
+
+    python -m ray_tpu._private.ray_perf
+
+or call :func:`run_microbenchmarks` programmatically (the bench gate embeds
+a fast subset in its JSON detail).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _timeit(fn, n: int) -> float:
+    """Ops/second of fn() called n times (one warmup batch)."""
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return n / (time.perf_counter() - t0)
+
+
+def run_microbenchmarks(
+    *,
+    tasks_n: int = 200,
+    actor_calls_n: int = 500,
+    put_mb: int = 16,
+    put_n: int = 8,
+    batch: int = 10,
+) -> Dict[str, float]:
+    """Returns {metric: value}. Requires a connected ray_tpu."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop():
+        return b""
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+    out: Dict[str, float] = {}
+
+    # single-client task throughput, batched submission (ray_perf
+    # "tasks per second" timers)
+    def burst_tasks():
+        ray_tpu.get([nop.remote() for _ in range(batch)], timeout=60)
+
+    out["tasks_per_s"] = round(_timeit(burst_tasks, tasks_n // batch) * batch, 1)
+
+    # actor method throughput (sync round-trips + pipelined batch)
+    a = Counter.remote()
+    ray_tpu.get(a.inc.remote(), timeout=60)
+
+    def actor_call():
+        ray_tpu.get(a.inc.remote(), timeout=60)
+
+    out["actor_calls_per_s"] = round(_timeit(actor_call, actor_calls_n), 1)
+
+    def actor_burst():
+        ray_tpu.get([a.inc.remote() for _ in range(batch)], timeout=60)
+
+    out["actor_calls_pipelined_per_s"] = round(
+        _timeit(actor_burst, actor_calls_n // batch) * batch, 1
+    )
+
+    # put / get bandwidth on large arrays (zero-copy reads)
+    arr = np.random.randint(0, 255, put_mb * 1024 * 1024, dtype=np.uint8)
+
+    refs = []
+
+    def put_one():
+        refs.append(ray_tpu.put(arr))
+
+    puts_per_s = _timeit(put_one, put_n)
+    out["put_gbps"] = round(puts_per_s * put_mb / 1024, 3)
+
+    ref = ray_tpu.put(arr)
+
+    def get_one():
+        ray_tpu.get(ref, timeout=60)
+
+    gets_per_s = _timeit(get_one, put_n)
+    out["get_gbps"] = round(gets_per_s * put_mb / 1024, 3)
+    del refs
+    return out
+
+
+def main():
+    import json
+
+    import ray_tpu
+
+    started = not ray_tpu.is_initialized()
+    if started:
+        ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+    try:
+        results = run_microbenchmarks(
+            tasks_n=1000, actor_calls_n=2000, put_mb=64, put_n=10
+        )
+        print(json.dumps(results, indent=2))
+    finally:
+        if started:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,8 @@ class Raylet:
                                       node_id.hex()[:12])
         self.spilled: Dict[bytes, str] = {}  # oid -> file path
         self.spilled_bytes = 0
+        self._spilling: Set[bytes] = set()  # oids with an in-flight spill
+        self._ever_workers: Set[bytes] = set()  # for log tailing after death
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -146,6 +148,8 @@ class Raylet:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._memory_monitor_loop()))
+        if GLOBAL_CONFIG.log_to_driver:
+            self._tasks.append(loop.create_task(self._log_monitor_loop()))
         if GLOBAL_CONFIG.prestart_workers:
             n = int(self.total_resources.get("CPU", 1))
             n = min(n, max(1, (os.cpu_count() or 4)))
@@ -271,6 +275,7 @@ class Raylet:
         w = WorkerHandle(worker_id, proc)
         w.tpu = tpu
         self.workers[worker_id] = w
+        self._ever_workers.add(worker_id)
         return w
 
     async def rpc_register_worker(self, conn, data):
@@ -896,6 +901,54 @@ class Raylet:
             w.proc.kill()
         return True
 
+    # ------------- log monitor (log_to_driver) -------------
+    # Parity: reference log monitor tailing worker logs to the driver
+    # (services.py:971). Tails THIS raylet's worker log files and forwards
+    # new lines through the GCS "logs" pubsub channel.
+
+    async def _log_monitor_loop(self):
+        offsets: Dict[str, int] = {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        my_workers_prefix = "worker-"
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            try:
+                batch = []
+                if not os.path.isdir(log_dir):
+                    continue
+                for fname in os.listdir(log_dir):
+                    if not fname.startswith(my_workers_prefix):
+                        continue
+                    wid_hex = fname[len(my_workers_prefix):-4]
+                    # tail workers that EVER belonged to this raylet (a dead
+                    # worker's final traceback is the most diagnostic output)
+                    if not any(
+                        w.hex().startswith(wid_hex)
+                        for w in self._ever_workers
+                    ):
+                        continue
+                    path = os.path.join(log_dir, fname)
+                    size = os.path.getsize(path)
+                    off = offsets.get(path, 0)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read(min(size - off, 256 * 1024))
+                    offsets[path] = off + len(data)
+                    lines = data.decode(errors="replace").splitlines()
+                    if lines:
+                        batch.append(
+                            {"worker": wid_hex,
+                             "node": self.node_id.hex()[:12],
+                             "lines": lines}
+                        )
+                if batch and self.gcs and not self.gcs.closed:
+                    await self.gcs.call_async("publish_logs", batch,
+                                              timeout=10)
+            except Exception:
+                pass  # log forwarding is best-effort
+
     # ------------- memory monitor: spilling + OOM -------------
     # Parity: reference MemoryMonitor (memory_monitor.h:52) + LocalObjectManager
     # spilling (local_object_manager.h:41) + worker-killing policy
@@ -949,30 +1002,38 @@ class Raylet:
                 st = self.store.stats()
 
     async def _spill_object(self, oid) -> bool:
-        view = self.store.get(oid, timeout=0)
-        if view is None:
+        # Concurrent spillers (memory monitor + spill_now callers) may pick
+        # the same candidate: one wins, the rest skip.
+        if oid.binary() in self._spilling or oid.binary() in self.spilled:
             return False
-        loop = asyncio.get_running_loop()
+        self._spilling.add(oid.binary())
         try:
-            os.makedirs(self.spill_dir, exist_ok=True)
-            path = os.path.join(self.spill_dir, oid.hex())
-            tmp = path + f".tmp.{os.getpid()}"
+            view = self.store.get(oid, timeout=0)
+            if view is None:
+                return False
+            loop = asyncio.get_running_loop()
+            try:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                path = os.path.join(self.spill_dir, oid.hex())
+                tmp = path + f".tmp.{os.urandom(4).hex()}"
 
-            def write():  # disk I/O off the event loop (heartbeats keep
-                with open(tmp, "wb") as f:  # flowing during GB-scale spills)
-                    f.write(view)
-                os.replace(tmp, path)
+                def write():  # disk I/O off the event loop (heartbeats keep
+                    with open(tmp, "wb") as f:  # flowing during big spills)
+                        f.write(view)
+                    os.replace(tmp, path)
 
-            await loop.run_in_executor(None, write)
+                await loop.run_in_executor(None, write)
+            finally:
+                view.release()
+                self.store.release(oid)
+            self.spilled[oid.binary()] = path
+            self.spilled_bytes += os.path.getsize(path)
+            self.store.delete(oid)  # refcount-safe: deferred if pinned
+            logger.info("spilled %s (%d bytes on disk)", oid.hex()[:12],
+                        self.spilled_bytes)
+            return True
         finally:
-            view.release()
-            self.store.release(oid)
-        self.spilled[oid.binary()] = path
-        self.spilled_bytes += os.path.getsize(path)
-        self.store.delete(oid)  # refcount-safe: deferred if pinned
-        logger.info("spilled %s (%d bytes on disk)", oid.hex()[:12],
-                    self.spilled_bytes)
-        return True
+            self._spilling.discard(oid.binary())
 
     async def _restore_object(self, oid) -> bool:
         """Bring a spilled object back into the store (get-path demand)."""
@@ -1027,18 +1088,22 @@ class Raylet:
                 return None  # e.g. ObjectExists: concurrent restore won
         return None
 
-    async def rpc_delete_spilled(self, conn, oid_bytes: bytes):
-        """Owner freed the object: drop its spill file (lifetime parity with
-        the in-store copy)."""
-        path = self.spilled.pop(oid_bytes, None)
-        if path is None:
-            return False
+    async def rpc_free_local_object(self, conn, oid_bytes: bytes):
+        """GCS free fan-out: drop this node's copy — store and/or disk."""
+        from ray_tpu._private.ids import ObjectID
+
         try:
-            size = os.path.getsize(path)
-            os.unlink(path)
-            self.spilled_bytes = max(0, self.spilled_bytes - size)
-        except OSError:
+            self.store.delete(ObjectID(oid_bytes))
+        except Exception:
             pass
+        path = self.spilled.pop(oid_bytes, None)
+        if path is not None:
+            try:
+                size = os.path.getsize(path)
+                os.unlink(path)
+                self.spilled_bytes = max(0, self.spilled_bytes - size)
+            except OSError:
+                pass
         return True
 
     async def rpc_spill_now(self, conn, bytes_needed: int):

@@ -1,0 +1,100 @@
+"""Test/chaos utilities.
+
+Parity: reference ``python/ray/_private/test_utils.py`` — ``NodeKillerActor
+:1400`` / ``kill_raylet:1741``: random fault injection used by the nightly
+chaos suite to prove lineage reconstruction + actor restart under fire.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class ChaosKiller:
+    """Driver-side chaos thread: randomly SIGKILLs worker processes (and
+    optionally whole raylets) of a simulated ``cluster_utils.Cluster`` while
+    a workload runs. Tasks with retries / lineage must still complete."""
+
+    def __init__(self, cluster, *, kill_interval_s: float = 0.5,
+                 kill_nodes: bool = False, seed: int = 0,
+                 spare_head: bool = True):
+        self.cluster = cluster
+        self.kill_interval_s = kill_interval_s
+        self.kill_nodes = kill_nodes
+        self.spare_head = spare_head
+        self.rng = random.Random(seed)
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- targets --
+    def _worker_procs(self) -> List[int]:
+        import os
+
+        raylet_pids = {
+            n.proc.pid
+            for n in self.cluster._impl.nodes.values()
+            if n.proc.poll() is None
+        }
+        procs = []
+        try:
+            # workers are children of raylets: find them via /proc
+            for pid in os.listdir("/proc"):
+                if not pid.isdigit():
+                    continue
+                try:
+                    with open(f"/proc/{pid}/stat") as f:
+                        ppid = int(f.read().split()[3])
+                    if ppid in raylet_pids:
+                        with open(f"/proc/{pid}/cmdline") as f:
+                            cmd = f.read()
+                        if "worker_main" in cmd:
+                            procs.append(int(pid))
+                except (OSError, ValueError, IndexError):
+                    continue
+        except OSError:
+            pass
+        return procs
+
+    def _kill_once(self):
+        import os
+        import signal
+
+        if self.kill_nodes and self.rng.random() < 0.3:
+            handles = list(self.cluster._impl.nodes.values())
+            nodes = handles[1:] if self.spare_head else handles
+            if nodes:
+                victim = self.rng.choice(nodes)
+                try:
+                    self.cluster.remove_node(victim)
+                    self.kills += 1
+                except Exception:
+                    pass
+                return
+        pids = self._worker_procs()
+        if pids:
+            try:
+                os.kill(self.rng.choice(pids), signal.SIGKILL)
+                self.kills += 1
+            except OSError:
+                pass
+
+    # -- lifecycle --
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                time.sleep(self.kill_interval_s)
+                self._kill_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self.kills

@@ -1,0 +1,70 @@
+"""CLI: ``python -m ray_tpu.scripts <command>``.
+
+Parity: reference ``ray status`` / ``ray list tasks|actors|nodes`` /
+``ray summary tasks`` / ``ray timeline`` (python/ray/scripts/scripts.py +
+util/state CLI). Connects to a running cluster via ``--address``
+(``tcp:<head>:<port>``) or the ``RAYTPU_ADDRESS`` env var.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    p.add_argument("--address", default=os.environ.get("RAYTPU_ADDRESS"),
+                   help="cluster address, e.g. tcp:10.0.0.1:6379")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="cluster health/usage overview")
+    for what in ("tasks", "actors", "nodes", "placement-groups"):
+        lp = sub.add_parser(what, help=f"list {what}")
+        if what == "tasks":
+            lp.add_argument("--state")
+            lp.add_argument("--name")
+    sub.add_parser("summary", help="per-task-name state counts")
+    tp = sub.add_parser("timeline", help="dump chrome-trace JSON")
+    tp.add_argument("-o", "--output", default="timeline.json")
+    args = p.parse_args(argv)
+
+    if not args.address:
+        print("error: --address (or RAYTPU_ADDRESS) required", file=sys.stderr)
+        return 2
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    if args.cmd == "status":
+        print(json.dumps(state.cluster_status(), indent=2, default=str))
+    elif args.cmd == "tasks":
+        print(json.dumps(
+            state.list_tasks(name=args.name, state=args.state),
+            indent=2, default=str,
+        ))
+    elif args.cmd == "actors":
+        print(json.dumps(state.list_actors(), indent=2, default=str))
+    elif args.cmd == "nodes":
+        print(json.dumps(state.list_nodes(), indent=2, default=str))
+    elif args.cmd == "placement-groups":
+        print(json.dumps(state.list_placement_groups(), indent=2,
+                         default=str))
+    elif args.cmd == "summary":
+        print(json.dumps(state.summarize_tasks(), indent=2))
+    elif args.cmd == "timeline":
+        events = state.timeline(args.output)
+        print(f"wrote {len(events)} events to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+Parity: reference ``python/ray/util/metrics.py`` (Counter:150,
+Histogram:215, Gauge:290) over the OpenCensus pipeline. TPU-build shape:
+an in-process registry; each worker/driver flushes snapshots to the GCS KV
+(``metrics:<worker>`` keys) every ``metrics_report_interval_ms``, and
+``ray_tpu.util.state``-style readers aggregate across processes — no
+Prometheus dependency in the wheel (exporting the aggregate is a thin HTTP
+layer left to deployments).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_last_flush = [0.0]
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name or any(c.isspace() for c in name):
+            raise ValueError(f"bad metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _maybe_flush(self):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        interval = GLOBAL_CONFIG.metrics_report_interval_ms / 1e3
+        now = time.monotonic()
+        if now - _last_flush[0] < interval:
+            return
+        _last_flush[0] = now
+        flush_to_gcs()
+
+
+class Counter(Metric):
+    """Monotonically increasing count."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value < 0:
+            raise ValueError("Counter.inc value must be >= 0")
+        with self._lock:
+            k = self._key(tags)
+            self._values[k] = self._values.get(k, 0.0) + value
+        self._maybe_flush()
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "counter", "values": list(self._values.items())}
+
+
+class Gauge(Metric):
+    """Last-written value."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+        self._maybe_flush()
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "gauge", "values": list(self._values.items())}
+
+
+class Histogram(Metric):
+    """Bucketed observations."""
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.1, 1, 10, 100, 1000]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            k = self._key(tags)
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1)
+            )
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+        self._maybe_flush()
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "type": "histogram",
+                "boundaries": self.boundaries,
+                "values": [
+                    (k, {"counts": c, "sum": self._sums.get(k, 0.0)})
+                    for k, c in self._counts.items()
+                ],
+            }
+
+
+def flush_to_gcs():
+    """Push this process's metric snapshots to the GCS KV (best effort)."""
+    from ray_tpu._private.worker import global_worker
+
+    cw = global_worker.core_worker
+    if cw is None:
+        return
+    with _registry_lock:
+        snap = {name: m.snapshot() for name, m in _registry.items()}
+    if not snap:
+        return
+    try:
+        import cloudpickle
+
+        cw.gcs.call(
+            "kv_put",
+            [f"metrics:{cw.worker_id.hex()}", cloudpickle.dumps(snap), True],
+        )
+    except Exception:
+        pass
+
+
+def collect_cluster_metrics() -> Dict[str, Dict]:
+    """Aggregate all processes' flushed snapshots (reader side)."""
+    import cloudpickle
+
+    from ray_tpu._private.worker import require_connected
+
+    gcs = require_connected().gcs
+    out: Dict[str, Dict] = {}
+    for key in gcs.call("kv_keys", "metrics:"):
+        blob = gcs.call("kv_get", key)
+        if not blob:
+            continue
+        for name, snap in cloudpickle.loads(blob).items():
+            agg = out.setdefault(
+                name, {"type": snap["type"], "values": {}}
+            )
+            for tags, val in snap["values"]:
+                tkey = tuple(tuple(t) for t in tags)
+                if snap["type"] in ("counter",):
+                    agg["values"][tkey] = agg["values"].get(tkey, 0.0) + val
+                elif snap["type"] == "gauge":
+                    agg["values"][tkey] = val
+                else:  # histogram: merge counts/sums
+                    cur = agg["values"].get(tkey)
+                    if cur is None:
+                        agg["values"][tkey] = {
+                            "counts": list(val["counts"]),
+                            "sum": val["sum"],
+                        }
+                    else:
+                        cur["counts"] = [
+                            a + b for a, b in zip(cur["counts"], val["counts"])
+                        ]
+                        cur["sum"] += val["sum"]
+    return out

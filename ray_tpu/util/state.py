@@ -1,0 +1,157 @@
+"""State API: programmatic cluster introspection.
+
+Parity: reference ``python/ray/util/state/api.py:109`` (StateApiClient,
+``list_actors:782``, ``list_tasks:1009``, ``summarize_tasks:1367``) backed
+by the GCS task-event sink, plus ``ray.timeline()``
+(``_private/state.py:831``) emitting Chrome-trace JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import require_connected
+
+
+def _gcs():
+    return require_connected().gcs
+
+
+def list_tasks(
+    *,
+    name: Optional[str] = None,
+    state: Optional[str] = None,
+    limit: int = 1000,
+) -> List[Dict[str, Any]]:
+    """Task records with lifecycle timestamps. States:
+    PENDING_NODE_ASSIGNMENT | RUNNING | FINISHED | FAILED."""
+    recs = _gcs().call(
+        "list_task_events", {"name": name, "state": state, "limit": limit}
+    )
+    out = []
+    for r in recs:
+        out.append(
+            {
+                "task_id": bytes(r["task_id"]).hex(),
+                "name": r["name"],
+                "state": r["state"],
+                "node_id": bytes(r["node"]).hex() if r.get("node") else None,
+                "worker_id": (
+                    bytes(r["worker"]).hex() if r.get("worker") else None
+                ),
+                "actor_id": (
+                    bytes(r["actor_id"]).hex() if r.get("actor_id") else None
+                ),
+                "attempts": r.get("attempts", 0),
+                "error": r.get("error", ""),
+                "events": dict(r["states"]),
+            }
+        )
+    return out
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Per-task-name state counts (parity: ``ray summary tasks``)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in list_tasks(limit=100000):
+        per = summary.setdefault(t["name"] or "<anonymous>", {})
+        per[t["state"]] = per.get(t["state"], 0) + 1
+    return summary
+
+
+def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
+    recs = _gcs().call("list_actors", None)
+    out = []
+    for r in recs:
+        if state and r["state"] != state:
+            continue
+        out.append(
+            {
+                "actor_id": bytes(r["actor_id"]).hex(),
+                "state": r["state"],
+                "name": r.get("name", ""),
+                "node_id": (
+                    bytes(r["address"][2]).hex() if r.get("address") else None
+                ),
+                "num_restarts": r.get("num_restarts", 0),
+                "death_cause": r.get("death_cause", ""),
+            }
+        )
+    return out
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in _gcs().call("get_all_nodes", None):
+        out.append(
+            {
+                "node_id": bytes(n["node_id"]).hex(),
+                "alive": n.get("alive", True),
+                "resources": n.get("resources") or {},
+                "raylet_addr": n.get("raylet_addr", ""),
+            }
+        )
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    table = _gcs().call("placement_group_table", None) or {}
+    out = []
+    for pid, rec in table.items():
+        out.append(
+            {
+                "placement_group_id": pid,
+                "state": rec["state"],
+                "name": rec.get("name", ""),
+                "strategy": rec["strategy"],
+                "bundles": rec["bundles"],
+            }
+        )
+    return out
+
+
+def cluster_status() -> Dict[str, Any]:
+    """One-shot health/usage view (parity: ``ray status``)."""
+    import ray_tpu
+
+    nodes = list_nodes()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "actors": len(list_actors()),
+        "task_summary": summarize_tasks(),
+        "placement_groups": len(list_placement_groups()),
+    }
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace events (load in chrome://tracing / Perfetto).
+    Parity: ``ray.timeline()`` (reference _private/state.py:831)."""
+    events = []
+    for t in list_tasks(limit=100000):
+        ev = t["events"]
+        start = ev.get("RUNNING")
+        end = ev.get("FINISHED") or ev.get("FAILED")
+        if start is None:
+            continue
+        if end is None or end < start:
+            end = start
+        events.append(
+            {
+                "name": t["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, (end - start)) * 1e6,
+                "pid": t["node_id"] or "driver",
+                "tid": t["worker_id"] or "?",
+                "args": {"task_id": t["task_id"], "state": t["state"]},
+            }
+        )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

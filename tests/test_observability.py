@@ -1,0 +1,164 @@
+"""Observability tests: task events/state API, timeline, metrics, perf
+microbench, chaos killer, log-to-driver.
+
+Parity surfaces: reference state API tests (``ray list tasks/actors``),
+``ray.timeline()``, util.metrics, ray_perf, and the chaos suite's
+NodeKiller (test_utils.py:1400).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def rt_obs():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_list_tasks_and_states(rt_obs):
+    @ray_tpu.remote
+    def fine():
+        return 1
+
+    @ray_tpu.remote(max_retries=0)
+    def broken():
+        raise ValueError("boom")
+
+    ray_tpu.get([fine.remote() for _ in range(3)], timeout=60)
+    with pytest.raises(Exception):
+        ray_tpu.get(broken.remote(), timeout=60)
+    # events are batched with a ~1s flush cadence
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        fins = [t for t in tasks if t["name"] == "fine"
+                and t["state"] == "FINISHED"]
+        fails = [t for t in tasks if t["name"] == "broken"
+                 and t["state"] == "FAILED"]
+        if len(fins) >= 3 and len(fails) >= 1:
+            break
+        time.sleep(0.3)
+    assert len(fins) >= 3, tasks
+    assert len(fails) >= 1
+    assert "boom" in fails[0]["error"]
+    assert fins[0]["events"].get("RUNNING") is not None
+
+    summary = state.summarize_tasks()
+    assert summary["fine"]["FINISHED"] >= 3
+    assert summary["broken"]["FAILED"] >= 1
+
+
+def test_list_actors_and_nodes(rt_obs):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    status = state.cluster_status()
+    assert status["nodes_alive"] == 1
+    assert status["cluster_resources"]["CPU"] == 4
+
+
+def test_timeline_chrome_trace(rt_obs, tmp_path):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(4)], timeout=60)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        events = state.timeline(str(tmp_path / "trace.json"))
+        spans = [e for e in events if e["name"] == "work"]
+        if len(spans) >= 4:
+            break
+        time.sleep(0.3)
+    assert len(spans) >= 4
+    for e in spans:
+        assert e["ph"] == "X" and e["dur"] >= 0.05 * 1e6 * 0.5
+    import json
+
+    assert json.load(open(tmp_path / "trace.json"))
+
+
+def test_metrics_counter_gauge_histogram(rt_obs):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", tag_keys=("route",))
+    c.inc(2.0, {"route": "/a"})
+    c.inc(3.0, {"route": "/a"})
+    g = metrics.Gauge("test_depth")
+    g.set(7.0)
+    h = metrics.Histogram("test_lat", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    metrics.flush_to_gcs()
+    agg = metrics.collect_cluster_metrics()
+    assert agg["test_requests"]["values"][(("route", "/a"),)] == 5.0
+    assert agg["test_depth"]["values"][()] == 7.0
+    hist = agg["test_lat"]["values"][()]
+    assert hist["counts"] == [1, 1, 1]
+    assert hist["sum"] == 55.5
+
+
+def test_perf_microbenchmarks_run(rt_obs):
+    from ray_tpu._private.ray_perf import run_microbenchmarks
+
+    r = run_microbenchmarks(tasks_n=40, actor_calls_n=60, put_mb=4, put_n=3)
+    assert r["tasks_per_s"] > 1
+    assert r["actor_calls_per_s"] > 1
+    assert r["put_gbps"] > 0 and r["get_gbps"] > 0
+
+
+def test_chaos_worker_kills_tasks_survive():
+    """Random worker SIGKILLs during a retried workload: all tasks finish
+    (reference chaos suite property)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.test_utils import ChaosKiller
+
+    c = Cluster(initialize_head=True, head_node_args={"resources": {"CPU": 4}})
+    c.connect()
+    try:
+        @ray_tpu.remote(max_retries=10)
+        def chunk(i):
+            time.sleep(0.3)
+            return i
+
+        killer = ChaosKiller(c, kill_interval_s=0.4, seed=1).start()
+        refs = [chunk.remote(i) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=300)
+        kills = killer.stop()
+        assert sorted(out) == list(range(24))
+        assert kills >= 1, "chaos killer never fired"
+    finally:
+        c.shutdown()
+
+
+def test_log_to_driver(rt_obs, capfd):
+    @ray_tpu.remote
+    def printer():
+        print("hello-from-worker-xyz")
+        return 1
+
+    ray_tpu.get(printer.remote(), timeout=60)
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().err
+        if "hello-from-worker-xyz" in seen:
+            break
+        time.sleep(0.3)
+    assert "hello-from-worker-xyz" in seen
