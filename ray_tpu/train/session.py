@@ -29,13 +29,24 @@ class TrainContext:
 
 
 class _TrainSession:
-    """One per training attempt inside a TrainWorker."""
+    """One per training attempt inside a TrainWorker.
+
+    ``sync_reports``: bound the event queue to 1 so ``report`` blocks until
+    the driver consumes it — required for schedulers (ASHA/PBT) that must
+    be able to stop a trial *between* iterations (reference tune function-
+    trainable semantics). Train fit loops leave it unbounded."""
 
     def __init__(self, context: TrainContext,
-                 checkpoint: Optional[Checkpoint]):
+                 checkpoint: Optional[Checkpoint],
+                 sync_reports: bool = False):
         self.context = context
         self.start_checkpoint = checkpoint
+        self.sync_reports = sync_reports
         self.events: "queue.Queue[Dict]" = queue.Queue()
+        # sync mode: report() blocks until the driver explicitly acks (the
+        # scheduler decided CONTINUE) — a true rendezvous, so a STOP kills
+        # the trial BEFORE it computes another iteration.
+        self.report_ack = threading.Event()
         self.iteration = 0
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -55,6 +66,9 @@ class _TrainSession:
                 "checkpoint": ship_ckpt.to_dict() if ship_ckpt else None,
             }
         )
+        if self.sync_reports:
+            self.report_ack.wait()
+            self.report_ack.clear()
 
 
 _session_lock = threading.Lock()

@@ -72,9 +72,10 @@ class _TrainWorker:
 
     def start_training(self, train_fn, train_loop_config,
                        context: TrainContext,
-                       checkpoint_data: Optional[Dict]) -> int:
+                       checkpoint_data: Optional[Dict],
+                       sync_reports: bool = False) -> int:
         ckpt = Checkpoint.from_dict(checkpoint_data) if checkpoint_data else None
-        sess = _TrainSession(context, ckpt)
+        sess = _TrainSession(context, ckpt, sync_reports=sync_reports)
         self._session = sess
         _set_session(sess)
 
@@ -104,9 +105,14 @@ class _TrainWorker:
             raise RuntimeError("start_training not called")
         events: List[Dict] = []
         deadline = time.monotonic() + timeout
+        # Sync-report sessions (tune trials) hand over ONE event per poll;
+        # the producer stays blocked until the driver acks (ack_report), so
+        # the scheduler can stop the trial before its next iteration.
+        # Unbounded sessions (train fit loops) drain everything.
+        sync = sess.sync_reports
 
         def drain():
-            while True:
+            while not (sync and events):
                 try:
                     events.append(sess.events.get_nowait())
                 except queue.Empty:
@@ -134,6 +140,13 @@ class _TrainWorker:
             "error": err,
             "error_tb": getattr(err, "_raytpu_tb", None) if err else None,
         }
+
+    def ack_report(self) -> int:
+        """Sync-report rendezvous: release the train thread blocked in
+        session.report (the scheduler decided the trial continues)."""
+        if self._session is not None:
+            self._session.report_ack.set()
+        return 1
 
     def shutdown_session(self) -> int:
         if self._thread is not None:

@@ -1,0 +1,145 @@
+"""Trial schedulers: FIFO, ASHA early stopping, Population Based Training.
+
+Parity: reference ``python/ray/tune/schedulers/`` —
+``async_hyperband.py`` (ASHA) and ``pbt.py`` (PBT). The controller calls
+``on_trial_result`` for every report and acts on the returned decision.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+# PBT: restart this trial with a new config + checkpoint (exploit+explore)
+EXPLOIT = "EXPLOIT"
+
+
+class FIFOScheduler:
+    def on_trial_result(self, trial, result) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result) -> None:
+        pass
+
+
+class ASHAScheduler:
+    """Async Successive Halving: when a trial reaches rung r (iteration
+    grace_period * reduction_factor^k), it continues only if its metric is
+    in the top 1/reduction_factor of results recorded at that rung."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be max|min")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung iteration -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = {}
+        self._trial_last_it: Dict[Any, int] = {}
+        r = grace_period
+        self._rung_levels = []
+        while r < max_t:
+            self._rung_levels.append(r)
+            r *= reduction_factor
+
+    def _score(self, result) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result) -> str:
+        it = int(result.get("training_iteration", 0))
+        last = self._trial_last_it.get(trial, 0)
+        self._trial_last_it[trial] = it
+        # rung CROSSING, not exact membership: a trial reporting every k-th
+        # iteration must still be evaluated at the rung it passed
+        crossed = [r for r in self._rung_levels if last < r <= it]
+        if not crossed:
+            return CONTINUE
+        score = self._score(result)
+        for rung in crossed:
+            recorded = self._rungs.setdefault(rung, [])
+            recorded.append(score)
+            recorded.sort(reverse=True)
+            k = max(1, len(recorded) // self.rf)
+            cutoff = recorded[k - 1]
+            if score < cutoff:
+                return STOP
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result) -> None:
+        pass
+
+
+class PopulationBasedTraining:
+    """PBT: every ``perturbation_interval`` iterations, a bottom-quantile
+    trial clones a top-quantile trial's checkpoint and config, with
+    hyperparameters perturbed (x1.2 / x0.8) or resampled."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self._last: Dict[Any, Tuple[int, float]] = {}  # trial -> (iter, score)
+        self.num_exploits = 0
+
+    def _score(self, result) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result) -> str:
+        it = int(result.get("training_iteration", 0))
+        self._last[trial] = (it, self._score(result))
+        if it == 0 or it % self.interval:
+            return CONTINUE
+        scores = sorted(
+            (s for _, s in self._last.values()), reverse=True
+        )
+        if len(scores) < 3:
+            return CONTINUE
+        n_q = max(1, int(len(scores) * self.quantile))
+        lower_cut = scores[-n_q]
+        my = self._score(result)
+        if my <= lower_cut and my < scores[n_q - 1]:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit_target(self, trials) -> Optional[Any]:
+        """Pick a top-quantile trial to clone from."""
+        scored = [
+            (self._last[t][1], t) for t in trials if t in self._last
+        ]
+        if not scored:
+            return None
+        scored.sort(key=lambda x: -x[0])
+        n_q = max(1, int(len(scored) * self.quantile))
+        return self.rng.choice([t for _, t in scored[:n_q]])
+
+    def explore(self, config: Dict) -> Dict:
+        """Perturb the donor's config (x1.2 / x0.8 or resample)."""
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif callable(getattr(spec, "sample", None)):
+                out[key] = spec.sample(self.rng)
+            elif isinstance(out[key], (int, float)):
+                out[key] = out[key] * self.rng.choice([0.8, 1.2])
+        self.num_exploits += 1
+        return out
+
+    def on_trial_complete(self, trial, result) -> None:
+        self._last.pop(trial, None)

@@ -1,0 +1,106 @@
+"""Search-space primitives + sample/grid expansion.
+
+Parity: reference ``ray.tune`` search space API (``tune.grid_search``,
+``tune.choice/uniform/loguniform/randint``) and the basic-variant-generator
+(grid x random sampling) that backs ``Tuner(param_space=...)``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List
+
+
+class _Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        if not values:
+            raise ValueError("grid_search needs at least one value")
+        self.values = list(values)
+
+
+class Choice(_Domain):
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+class Uniform(_Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(_Domain):
+    def __init__(self, low: float, high: float):
+        if low <= 0 or high <= 0:
+            raise ValueError("loguniform bounds must be > 0")
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class RandInt(_Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+# -- public constructors (parity: tune.grid_search etc.) --
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(values: List[Any]) -> Choice:
+    return Choice(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def generate_variants(
+    param_space: Dict[str, Any], num_samples: int, seed: int = 0
+) -> List[Dict[str, Any]]:
+    """Expand grid axes (cartesian product), then draw ``num_samples``
+    random samples of the stochastic axes for each grid point (the
+    reference basic variant generator's semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    for combo in itertools.product(*grid_values) if grid_keys else [()]:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, _Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

@@ -1,0 +1,237 @@
+"""Tuner + trial controller.
+
+Parity: reference ``python/ray/tune/tuner.py:53`` /
+``execution/tune_controller.py:49`` (``step():267``): trials run as actors
+(reusing the Train worker-actor body — one shared AIR execution substrate,
+like the reference's RayActorManager), the controller polls reports,
+feeds them to the scheduler (FIFO/ASHA/PBT), and assembles a ResultGrid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import _TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 2
+    scheduler: Any = None  # FIFOScheduler | ASHAScheduler | PBT
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ("max", "min"):
+            raise ValueError("mode must be max|min")
+
+
+class Trial:
+    _next = 0
+
+    def __init__(self, config: Dict[str, Any]):
+        Trial._next += 1
+        self.trial_id = f"trial_{Trial._next:05d}"
+        self.config = config
+        self.status = PENDING
+        self.actor = None
+        self.last_result: Dict[str, Any] = {}
+        self.iterations = 0
+        self.error: Optional[str] = None
+        self.checkpoint: Optional[Dict] = None  # latest reported (dict form)
+        self.start_checkpoint: Optional[Dict] = None  # for PBT exploits
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status}, it={self.iterations})"
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    trial_id: str = ""
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric, mode):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        ok = [r for r in self._results
+              if r.error is None and metric in r.metrics]
+        if not ok:
+            raise ValueError("no successful trial reported "
+                             f"metric {metric!r}")
+        key = (lambda r: r.metrics[metric])
+        return max(ok, key=key) if mode == "max" else min(ok, key=key)
+
+    @property
+    def errors(self) -> List[TrialResult]:
+        return [r for r in self._results if r.error is not None]
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.resources = resources_per_trial or {"CPU": 1}
+
+    # -- controller --
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(
+            self.param_space, tc.num_samples, seed=tc.seed
+        )
+        trials = [Trial(cfg) for cfg in variants]
+        actor_cls = ray_tpu.remote(resources=dict(self.resources))(
+            _TrainWorker
+        )
+
+        def start(trial: Trial):
+            trial.actor = actor_cls.remote()
+            ctx = TrainContext(
+                world_rank=0, world_size=1, experiment_name=trial.trial_id
+            )
+            ray_tpu.get(
+                trial.actor.start_training.remote(
+                    self.trainable, trial.config, ctx,
+                    trial.start_checkpoint, True,  # sync_reports: the
+                    # scheduler must be able to stop between iterations
+                ),
+                timeout=120,
+            )
+            trial.status = RUNNING
+
+        def stop_actor(trial: Trial):
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+
+        live: List[Trial] = []
+        queue = list(trials)
+        try:
+            while queue or live:
+                while queue and len(live) < tc.max_concurrent_trials:
+                    t = queue.pop(0)
+                    start(t)
+                    live.append(t)
+                refs = [t.actor.poll.remote(timeout=5.0) for t in live]
+                still = []
+                for trial, ref in zip(live, refs):
+                    # per-trial fault isolation: a dead trial actor (OOM
+                    # kill, node loss) becomes ERROR on that trial only —
+                    # not a crashed experiment
+                    try:
+                        p = ray_tpu.get(ref, timeout=120)
+                    except Exception as e:
+                        trial.status = ERROR
+                        trial.error = f"trial actor died: {e!r}"
+                        stop_actor(trial)
+                        scheduler.on_trial_complete(trial, trial.last_result)
+                        continue
+                    decision = CONTINUE
+                    for ev in p["events"]:
+                        trial.iterations += 1
+                        m = dict(ev["metrics"])
+                        m.setdefault("training_iteration", trial.iterations)
+                        trial.last_result = m
+                        if ev.get("checkpoint") is not None:
+                            trial.checkpoint = ev["checkpoint"]
+                        decision = scheduler.on_trial_result(trial, m)
+                        if decision != CONTINUE:
+                            break
+                    if decision == CONTINUE and p["events"] and not p["done"]:
+                        # rendezvous ack: unblock session.report for the
+                        # next iteration
+                        trial.actor.ack_report.remote()
+                    if decision == STOP:
+                        trial.status = TERMINATED
+                        stop_actor(trial)
+                        scheduler.on_trial_complete(trial, trial.last_result)
+                        continue
+                    if decision == EXPLOIT:
+                        donor = scheduler.exploit_target(
+                            [t for t in trials if t is not trial
+                             and t.checkpoint is not None]
+                        )
+                        if donor is not None:
+                            stop_actor(trial)
+                            trial.config = scheduler.explore(donor.config)
+                            trial.start_checkpoint = donor.checkpoint
+                            trial.iterations = donor.iterations
+                            start(trial)
+                        still.append(trial)
+                        continue
+                    if p["done"]:
+                        if p["error"] is not None:
+                            trial.status = ERROR
+                            trial.error = (
+                                f"{p['error']!r}\n{p.get('error_tb') or ''}"
+                            )
+                        else:
+                            trial.status = TERMINATED
+                        stop_actor(trial)
+                        scheduler.on_trial_complete(trial, trial.last_result)
+                        continue
+                    still.append(trial)
+                live = still
+        finally:
+            for t in trials:
+                stop_actor(t)
+        results = [
+            TrialResult(
+                config=t.config,
+                metrics=t.last_result,
+                checkpoint=(
+                    Checkpoint.from_dict(t.checkpoint)
+                    if t.checkpoint else None
+                ),
+                error=t.error,
+                trial_id=t.trial_id,
+            )
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)

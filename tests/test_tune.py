@@ -1,0 +1,140 @@
+"""Tune-equivalent tests: search spaces, Tuner, ASHA, PBT.
+
+Parity surfaces: reference tune tests — variant generation, best-result
+selection, ASHA early stopping, PBT exploit/explore.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture
+def rt_tune():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_variant_generation():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.choice(["x", "y"]),
+        "c": 42,
+    }
+    v = generate_variants(space, num_samples=2, seed=0)
+    assert len(v) == 6  # 3 grid points x 2 samples
+    assert {x["a"] for x in v} == {1, 2, 3}
+    assert all(x["c"] == 42 for x in v)
+    assert all(x["b"] in ("x", "y") for x in v)
+
+    lo = generate_variants({"lr": tune.loguniform(1e-4, 1e-1)}, 20, seed=1)
+    assert all(1e-4 <= x["lr"] <= 1e-1 for x in lo)
+
+
+def test_tuner_finds_best(rt_tune):
+    def objective(config):
+        from ray_tpu.train import session
+
+        # peak score at width=64
+        score = -abs(config["width"] - 64) + config["bonus"]
+        for i in range(3):
+            session.report({"score": score + i * 0.1})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={
+            "width": tune.grid_search([16, 64, 256]),
+            "bonus": 0.0,
+        },
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3
+        ),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["width"] == 64
+    assert best.metrics["score"] == pytest.approx(0.2)
+
+
+def test_tuner_trial_error_isolated(rt_tune):
+    def objective(config):
+        from ray_tpu.train import session
+
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        session.report({"score": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert "bad trial" in grid.errors[0].error
+    assert grid.get_best_result().config["x"] == 2
+
+
+def test_asha_stops_bad_trials_early(rt_tune):
+    def objective(config):
+        from ray_tpu.train import session
+
+        for i in range(1, 9):
+            session.report(
+                {"score": config["quality"] * i, "training_iteration": i}
+            )
+
+    # Strong trials listed first: ASHA promotes early arrivals optimistically
+    # (async halving), so weak trials must land on a populated rung to be cut.
+    grid = tune.Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([2.0, 1.0, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=tune.ASHAScheduler(
+                metric="score", grace_period=2, reduction_factor=2, max_t=8
+            ),
+        ),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] == 2.0
+    # weak trials must have been cut before finishing all 8 iterations
+    by_quality = {r.config["quality"]: r for r in grid}
+    assert by_quality[2.0].metrics["training_iteration"] == 8
+    assert by_quality[0.1].metrics["training_iteration"] < 8
+
+
+def test_pbt_exploits_and_perturbs(rt_tune):
+    def objective(config):
+        import time as _t
+
+        from ray_tpu.train import Checkpoint, session
+
+        start = session.get_checkpoint()
+        base = 0 if start is None else start.to_dict()["it"]
+        for i in range(base + 1, base + 13):
+            session.report(
+                {"score": config["lr"] * 10 + i * 0.01,
+                 "training_iteration": i},
+                checkpoint=Checkpoint.from_dict({"it": i}),
+            )
+            _t.sleep(0.02)
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0]},
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.1, 0.3, 0.6, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=pbt,
+        ),
+    ).fit()
+    assert pbt.num_exploits >= 1, "PBT never exploited"
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 10.0  # lr=1.0 territory
