@@ -1,0 +1,155 @@
+"""ray_tpu.serve — the Serve-equivalent model-serving library.
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Model.bind())
+    assert handle.remote(21).result() == 42
+
+Parity: reference ``python/ray/serve`` — @serve.deployment (api.py:242),
+serve.run (api.py:414), controller/replica reconciliation
+(controller.py:74, deployment_state.py), power-of-two-choices router
+(router.py:856), @serve.batch-style batching (router-side, step-sized for
+TPU replicas), request autoscaling (autoscaling_policy.py:95,129), HTTP
+proxy (http_proxy.py:194).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+_proxy = None  # module-level proxy handle (driver process)
+
+
+class Application:
+    """A bound deployment (parity: the .bind() result)."""
+
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, constructor: Callable, name: str,
+                 config: Dict[str, Any]):
+        self._constructor = constructor
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dict(self.config)
+        name = overrides.pop("name", self.name)
+        cfg.update(overrides)
+        return Deployment(self._constructor, name, cfg)
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               autoscaling_config: Optional[Dict] = None,
+               batch_max_size: Optional[int] = None,
+               batch_wait_timeout_s: float = 0.01,
+               ray_actor_options: Optional[Dict] = None,
+               user_config: Optional[Dict] = None):
+    """Decorator: make a class or function deployable."""
+
+    def wrap(obj):
+        ctor = obj
+        if not isinstance(obj, type):
+            # function deployment: wrap in a trivial callable holder
+            def make_fn_holder(fn):
+                class _FnDeployment:
+                    def __call__(self, *a, **kw):
+                        return fn(*a, **kw)
+
+                functools.update_wrapper(_FnDeployment, fn, updated=[])
+                return _FnDeployment
+
+            ctor = make_fn_holder(obj)
+        return Deployment(
+            ctor,
+            name or getattr(obj, "__name__", "deployment"),
+            {
+                "num_replicas": num_replicas,
+                "autoscaling_config": autoscaling_config,
+                "batch_max_size": batch_max_size,
+                "batch_wait_timeout_s": batch_wait_timeout_s,
+                "ray_actor_options": ray_actor_options or {},
+                "user_config": user_config,
+            },
+        )
+
+    return wrap(_func_or_class) if _func_or_class is not None else wrap
+
+
+def _get_or_start_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        ctrl_cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME)(
+            ServeController
+        )
+        try:
+            return ctrl_cls.remote()
+        except Exception:
+            return ray_tpu.get_actor(CONTROLLER_NAME)  # lost the race
+
+
+def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle once replicas exist."""
+    controller = _get_or_start_controller()
+    dep = app.deployment
+    ray_tpu.get(
+        controller.deploy.remote(
+            name or dep.name,
+            dep._constructor,
+            app.init_args,
+            app.init_kwargs,
+            dep.config,
+        ),
+        timeout=300,
+    )
+    return DeploymentHandle(controller, name or dep.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(_get_or_start_controller(), name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_start_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def delete(name: str) -> bool:
+    controller = _get_or_start_controller()
+    return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def start_http_proxy(port: int = 0) -> str:
+    """Start the HTTP ingress actor; returns its base URL."""
+    global _proxy
+    from ray_tpu.serve.http_proxy import HTTPProxy
+
+    controller = _get_or_start_controller()
+    proxy_cls = ray_tpu.remote(num_cpus=0.1)(HTTPProxy)
+    _proxy = proxy_cls.remote(controller, port)
+    return ray_tpu.get(_proxy.address.remote(), timeout=60)
+
+
+__all__ = [
+    "deployment", "run", "delete", "status", "get_deployment_handle",
+    "start_http_proxy", "Deployment", "Application", "DeploymentHandle",
+]

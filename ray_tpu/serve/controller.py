@@ -1,0 +1,209 @@
+"""Serve controller + replica actors.
+
+Parity: reference ``python/ray/serve/_private/controller.py:74``
+(ServeController reconciling DeploymentState over replica actors,
+deployment_state.py:1097,2130) and ``replica.py:447``. The controller is a
+detached named actor; each replica actor wraps the user's callable. Request
+autoscaling follows the reference BasicAutoscalingPolicy shape
+(autoscaling_policy.py:95): desired = ceil(total ongoing / target per
+replica), clamped to [min, max], driven by router-reported load.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class Replica:
+    """Actor body wrapping one copy of the user deployment."""
+
+    def __init__(self, constructor, init_args, init_kwargs):
+        self._callable = constructor(*init_args, **(init_kwargs or {}))
+
+    def handle_request(self, args, kwargs):
+        if callable(self._callable):
+            return self._callable(*args, **(kwargs or {}))
+        raise TypeError("deployment object is not callable")
+
+    def handle_batch(self, batch: List):
+        """Router-side dynamic batching: one call, a list of requests.
+        The user callable must accept a list and return a list (parity:
+        @serve.batch semantics, reference batching.py). The router enforces
+        one positional arg per request at submit time."""
+        out = self._callable([args[0] for args, _kw in batch])
+        if len(out) != len(batch):
+            raise ValueError(
+                f"batched deployment returned {len(out)} results for "
+                f"{len(batch)} requests"
+            )
+        return list(out)
+
+    def reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def health(self):
+        return "ok"
+
+
+class ServeController:
+    """Actor: owns deployment specs, reconciles replica sets, autoscales."""
+
+    DRAIN_GRACE_S = 5.0
+    ROUTER_TTL_S = 60.0
+
+    def __init__(self):
+        # name -> {"spec": {...}, "replicas": [handle], "version": int}
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+        # router-reported ongoing-request counts: (deployment, router_id)
+        self._load: Dict[str, Dict[str, Any]] = {}
+        # replicas pulled from rotation but still finishing in-flight work:
+        # (handle, kill_after_ts) — killed lazily on later controller calls
+        self._draining: List = []
+
+    def _reap_draining(self):
+        now = time.time()
+        keep = []
+        for handle, deadline in self._draining:
+            if now >= deadline:
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:
+                    pass
+            else:
+                keep.append((handle, deadline))
+        self._draining = keep
+
+    # -- deploy / reconcile --
+
+    def deploy(self, name: str, constructor, init_args, init_kwargs,
+               config: Dict[str, Any]):
+        existing = self.deployments.get(name)
+        version = (existing["version"] + 1) if existing else 1
+        dep = {
+            "spec": {
+                "constructor": constructor,
+                "init_args": init_args or (),
+                "init_kwargs": init_kwargs or {},
+                "config": dict(config),
+            },
+            "replicas": [],
+            "version": version,
+        }
+        old = existing["replicas"] if existing else []
+        self.deployments[name] = dep
+        self._scale_to(name, self._initial_target(config))
+        for r in old:  # tear down the previous version's replicas
+            self._stop_replica(r)
+        return {"name": name, "version": version,
+                "num_replicas": len(dep["replicas"])}
+
+    def _initial_target(self, config) -> int:
+        auto = config.get("autoscaling_config")
+        if auto:
+            return int(auto.get("min_replicas", 1))
+        return int(config.get("num_replicas", 1))
+
+    def _make_replica(self, name: str):
+        dep = self.deployments[name]
+        spec = dep["spec"]
+        # pass the user's actor options straight through (num_cpus/num_tpus/
+        # resources/... — ray_tpu.remote understands them all)
+        opts = dict(spec["config"].get("ray_actor_options") or {})
+        cls = ray_tpu.remote(**opts)(Replica)
+        return cls.remote(
+            spec["constructor"], spec["init_args"], spec["init_kwargs"]
+        )
+
+    def _stop_replica(self, handle):
+        """Pull from rotation now; kill after a drain grace window so
+        in-flight requests can finish (routers stop routing to it within
+        their refresh interval)."""
+        self._draining.append((handle, time.time() + self.DRAIN_GRACE_S))
+
+    def _scale_to(self, name: str, n: int):
+        dep = self.deployments[name]
+        while len(dep["replicas"]) < n:
+            dep["replicas"].append(self._make_replica(name))
+        while len(dep["replicas"]) > n:
+            self._stop_replica(dep["replicas"].pop())
+
+    # -- routing table --
+
+    def get_replicas(self, name: str):
+        self._reap_draining()
+        dep = self.deployments.get(name)
+        if dep is None:
+            return None
+        return {
+            "version": dep["version"],
+            "replicas": list(dep["replicas"]),
+            "config": dep["spec"]["config"],
+        }
+
+    def list_deployments(self):
+        return {
+            name: {
+                "version": d["version"],
+                "num_replicas": len(d["replicas"]),
+                "config": {
+                    k: v for k, v in d["spec"]["config"].items()
+                    if k != "ray_actor_options"
+                },
+            }
+            for name, d in self.deployments.items()
+        }
+
+    def delete_deployment(self, name: str):
+        dep = self.deployments.pop(name, None)
+        if dep is None:
+            return False
+        for r in dep["replicas"]:
+            self._stop_replica(r)
+        return True
+
+    # -- autoscaling --
+
+    def report_load(self, deployment: str, router_id: str, ongoing: int):
+        """Routers push their in-flight counts; drives the autoscaler.
+        (Routers throttle these to ~1/s each.)"""
+        self._reap_draining()
+        now = time.time()
+        per = self._load.setdefault(deployment, {})
+        per[router_id] = (ongoing, now)
+        # evict routers that stopped reporting (handle GC'd, driver gone)
+        for rid in [r for r, (_, ts) in per.items()
+                    if now - ts > self.ROUTER_TTL_S]:
+            del per[rid]
+        return self.autoscale_once(deployment)
+
+    def autoscale_once(self, name: str) -> Optional[int]:
+        dep = self.deployments.get(name)
+        if dep is None:
+            return None
+        auto = dep["spec"]["config"].get("autoscaling_config")
+        if not auto:
+            return None
+        now = time.time()
+        total = sum(
+            n for n, ts in self._load.get(name, {}).values()
+            if now - ts < 10.0
+        )
+        target = float(auto.get("target_ongoing_requests", 1.0))
+        desired = math.ceil(total / max(target, 1e-9)) if total else 0
+        desired = max(int(auto.get("min_replicas", 1)),
+                      min(int(auto.get("max_replicas", 1)), desired))
+        if desired != len(dep["replicas"]):
+            self._scale_to(name, desired)
+        return len(dep["replicas"])
+
+    def health(self):
+        return "ok"

@@ -1,0 +1,280 @@
+"""DeploymentHandle + router: power-of-two-choices with dynamic batching.
+
+Parity: reference ``python/ray/serve/handle.py:86`` → ``_private/router.py
+:856`` (power-of-two-choices replica scheduler) and ``batching.py``
+(@serve.batch). TPU twist: batching lives in the ROUTER — queued requests
+are grouped into one replica call so a TPU replica sees step-sized batches
+(continuous batching at the ingress, not per-replica asyncio)."""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+
+class _PendingRequest:
+    __slots__ = ("payload", "result", "error", "done")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class Router:
+    """Per-handle router: tracks its own in-flight counts per replica
+    (power of two choices), refreshes the replica set from the controller,
+    reports load for autoscaling, and batches when configured."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, controller, deployment: str):
+        self.controller = controller
+        self.deployment = deployment
+        self.router_id = os.urandom(6).hex()
+        self.rng = random.Random(self.router_id)
+        self._replicas: List = []
+        self._config: Dict[str, Any] = {}
+        self._version = -1
+        self._replica_ids: List = []
+        self._refreshed = 0.0
+        self._reported = 0.0
+        self._inflight: Dict[int, int] = {}  # replica idx -> count
+        self._outstanding: Dict[Any, int] = {}  # ref -> replica idx
+        self._lock = threading.Lock()
+        # batching state
+        self._batch_queue: List[_PendingRequest] = []
+        self._batch_running = False
+        self._reporter_started = False
+        self._refresh(force=True)
+
+    def _ensure_reporter(self):
+        """Autoscaled deployments get a 1/s background load reporter: burst
+        submitters and idle periods alike must be visible to the
+        autoscaler (a submit-driven report would miss both)."""
+        if self._reporter_started or not self._config.get(
+            "autoscaling_config"
+        ):
+            return
+        self._reporter_started = True
+
+        def loop():
+            while True:
+                time.sleep(self.REFRESH_S)
+                try:
+                    self._report_load(force=True)
+                except Exception:
+                    return  # cluster gone: reporter dies quietly
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    # -- replica set --
+
+    def _refresh(self, force=False):
+        now = time.monotonic()
+        if not force and now - self._refreshed < self.REFRESH_S:
+            return
+        self._refreshed = now
+        info = ray_tpu.get(
+            self.controller.get_replicas.remote(self.deployment), timeout=30
+        )
+        if info is None:
+            raise KeyError(f"no deployment {self.deployment!r}")
+        # identity-compare (actor ids): a same-size replica replacement must
+        # still invalidate the cached set
+        ids = [getattr(r, "_actor_id", None) for r in info["replicas"]]
+        with self._lock:
+            if info["version"] != self._version or ids != self._replica_ids:
+                self._replicas = info["replicas"]
+                self._replica_ids = ids
+                self._config = info["config"]
+                self._version = info["version"]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+                self._outstanding.clear()
+
+    def _pick(self) -> Tuple[int, Any]:
+        """Power of two choices on router-local in-flight counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment!r} has no replicas"
+                )
+            if n == 1:
+                i = 0
+            else:
+                a, b = self.rng.sample(range(n), 2)
+                i = a if self._inflight.get(a, 0) <= self._inflight.get(
+                    b, 0
+                ) else b
+            self._inflight[i] = self._inflight.get(i, 0) + 1
+            return i, self._replicas[i]
+
+    def _release(self, idx: int):
+        with self._lock:
+            self._inflight[idx] = max(0, self._inflight.get(idx, 0) - 1)
+
+    def _release_ref(self, ref):
+        with self._lock:
+            idx = self._outstanding.pop(ref, None)
+        if idx is not None:
+            self._release(idx)
+
+    def _reap_inflight(self):
+        """Observe completions even for never-awaited futures, so
+        fire-and-forget callers don't inflate load forever."""
+        with self._lock:
+            refs = list(self._outstanding)
+        if not refs:
+            return
+        try:
+            ready, _ = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0, fetch_local=False
+            )
+        except Exception:
+            return
+        for r in ready:
+            self._release_ref(r)
+
+    def _report_load(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._reported < self.REFRESH_S:
+            return  # throttle: one controller RPC per refresh window
+        self._reported = now
+        self._reap_inflight()
+        with self._lock:
+            ongoing = sum(self._inflight.values()) + len(self._batch_queue)
+        try:
+            self.controller.report_load.remote(
+                self.deployment, self.router_id, ongoing
+            )
+        except Exception:
+            pass
+
+    # -- non-batched path --
+
+    def submit(self, args, kwargs):
+        self._refresh()
+        self._reap_inflight()
+        self._ensure_reporter()
+        cfg = self._config
+        if cfg.get("batch_max_size"):
+            if len(args) != 1 or kwargs:
+                raise TypeError(
+                    "batched deployments take exactly one positional "
+                    "argument per request (the batch element)"
+                )
+            return self._submit_batched(args, kwargs)
+        idx, replica = self._pick()
+        ref = replica.handle_request.remote(list(args), dict(kwargs or {}))
+        with self._lock:
+            self._outstanding[ref] = idx
+        self._report_load()  # after registration: the request is visible
+        return _ResultFuture(ref, lambda: self._release_ref(ref))
+
+    # -- batched path --
+
+    def _submit_batched(self, args, kwargs):
+        req = _PendingRequest((args, kwargs))
+        with self._lock:
+            self._batch_queue.append(req)
+            # the running flag flips under THIS lock (both here and at
+            # thread exit), so a request can never be stranded between a
+            # thread's empty-check and its termination
+            if not self._batch_running:
+                self._batch_running = True
+                threading.Thread(target=self._batch_loop,
+                                 daemon=True).start()
+        return _LocalFuture(req)
+
+    def _batch_loop(self):
+        max_size = int(self._config.get("batch_max_size", 8))
+        wait_s = float(self._config.get("batch_wait_timeout_s", 0.01))
+        while True:
+            with self._lock:
+                if not self._batch_queue:
+                    self._batch_running = False
+                    return  # drained: restarted on next submit
+            time.sleep(wait_s)
+            with self._lock:
+                batch = self._batch_queue[:max_size]
+                self._batch_queue = self._batch_queue[len(batch):]
+            if not batch:
+                continue
+            try:
+                idx, replica = self._pick()
+            except Exception as e:
+                for r in batch:
+                    r.error = e
+                    r.done.set()
+                continue
+            try:
+                out = ray_tpu.get(
+                    replica.handle_batch.remote(
+                        [r.payload for r in batch]
+                    ),
+                    timeout=300,
+                )
+                for r, val in zip(batch, out):
+                    r.result = val
+                    r.done.set()
+            except Exception as e:
+                for r in batch:
+                    r.error = e
+                    r.done.set()
+            finally:
+                self._release(idx)
+
+
+class _ResultFuture:
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._released = False
+
+    def result(self, timeout: Optional[float] = 120.0):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if not self._released:
+                self._released = True
+                self._on_done()
+
+
+class _LocalFuture:
+    def __init__(self, req: _PendingRequest):
+        self._req = req
+
+    def result(self, timeout: Optional[float] = 120.0):
+        if not self._req.done.wait(timeout):
+            raise TimeoutError("batched request timed out")
+        if self._req.error is not None:
+            raise self._req.error
+        return self._req.result
+
+
+class DeploymentHandle:
+    """Picklable client handle (parity: serve.get_deployment_handle)."""
+
+    def __init__(self, controller, deployment: str):
+        self._controller = controller
+        self._deployment = deployment
+        self._router: Optional[Router] = None
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self._controller, self._deployment)
+        return self._router
+
+    def remote(self, *args, **kwargs):
+        """Submit a request; returns a future with .result(timeout)."""
+        return self._get_router().submit(args, kwargs)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._controller, self._deployment))

@@ -1,0 +1,79 @@
+"""HTTP ingress proxy actor.
+
+Parity: reference ``python/ray/serve/_private/http_proxy.py:194`` (per-node
+HTTPProxy actor in front of the router). Stdlib ThreadingHTTPServer (no
+ASGI dependency in the wheel): ``POST /<deployment>`` with a JSON body
+routes through a DeploymentHandle and returns the JSON result.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class HTTPProxy:
+    """Actor body: runs the HTTP server on a thread; routes via handles."""
+
+    def __init__(self, controller, port: int = 0):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._controller = controller
+        self._handles: Dict[str, DeploymentHandle] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[0]
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length)
+                    payload = json.loads(body) if body else None
+                    handle = proxy._handle_for(name)
+                    result = handle.remote(payload).result(timeout=120)
+                    out = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except KeyError:
+                    out = json.dumps(
+                        {"error": f"no deployment {name!r}"}
+                    ).encode()
+                    self.send_response(404)
+                except Exception as e:  # noqa: BLE001 — surfaced to client
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            do_GET = do_POST
+
+        # bind all interfaces: the proxy actor may live on any node and the
+        # ingress must be reachable from outside the host
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _handle_for(self, name: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        if name not in self._handles:
+            self._handles[name] = DeploymentHandle(self._controller, name)
+        return self._handles[name]
+
+    def address(self):
+        from ray_tpu._private.node import node_ip_address
+
+        _, port = self._server.server_address
+        return f"http://{node_ip_address()}:{port}"
+
+    def shutdown(self):
+        self._server.shutdown()
+        return True
