@@ -1,0 +1,69 @@
+"""Lazy task DAGs via ``.bind()``.
+
+Parity: reference ``python/ray/dag/dag_node.py`` — ``fn.bind(...)`` builds
+a DAG node instead of submitting; ``dag.execute(...)`` walks the graph,
+submits every task with upstream ObjectRefs as arguments (so the runtime
+pipelines the whole graph), and returns the root's ref. ``InputNode``
+parameterizes the DAG (one positional input, reference MultiOutputNode /
+kwargs variants omitted).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class InputNode:
+    """Placeholder for the value passed to ``dag.execute(value)``.
+
+    Usable bare or as a context manager (``with InputNode() as inp`` — API
+    parity with the reference's idiom; the context carries no state here).
+    """
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class DAGNode:
+    """One bound task invocation."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self._fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    # -- execution --
+
+    def execute(self, input_value: Any = None):
+        """Submit the whole graph; returns the ObjectRef of this node."""
+        cache: Dict[int, Any] = {}
+        return self._submit(input_value, cache)
+
+    def _submit(self, input_value, cache: Dict[int, Any]):
+        if id(self) in cache:  # diamond dependencies submit once
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._submit(input_value, cache)
+            if isinstance(v, InputNode):
+                return input_value
+            return v
+
+        args = [resolve(a) for a in self._args]
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        ref = self._fn.remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+    def __repr__(self):
+        name = getattr(self._fn, "__name__", "task")
+        return f"DAGNode({name}, {len(self._args)} args)"

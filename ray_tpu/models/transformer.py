@@ -43,7 +43,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
-    attn_impl: str = "dense"  # dense | ring | flash
+    attn_impl: str = "dense"  # dense | ring | ulysses | flash
     # Mixture-of-experts FFN (0 = dense MLP). Experts shard over the `ep`
     # mesh axis; dispatch/combine einsums carry GSPMD sharding constraints so
     # XLA inserts the expert all-to-all (reference has NO EP — SURVEY §2.5).
@@ -235,6 +235,12 @@ def select_attn_fn(config: TransformerConfig,
         from ray_tpu.ops.ring_attention import ring_attention
 
         return partial(ring_attention, mesh=mesh)
+    if c.attn_impl == "ulysses":
+        if mesh is None:
+            raise ValueError("ulysses attention needs a mesh")
+        from ray_tpu.ops.ulysses_attention import ulysses_attention
+
+        return partial(ulysses_attention, mesh=mesh)
     if c.attn_impl == "flash":
         from ray_tpu.ops.flash_attention import (
             flash_attention,

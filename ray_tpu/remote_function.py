@@ -32,6 +32,13 @@ class RemoteFunction:
         rf._opts = merged
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (reference
+        ray.dag: fn.bind(...).execute())."""
+        from ray_tpu.dag import DAGNode
+
+        return DAGNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         cw = require_connected()
         values = list(args)

@@ -1,0 +1,10 @@
+"""ray_tpu.util — utility APIs (parity: ray.util).
+
+ActorPool, Queue, collective verbs, placement groups, scheduling
+strategies, state API, metrics.
+"""
+
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Queue  # noqa: F401
+
+__all__ = ["ActorPool", "Queue"]

@@ -1,0 +1,103 @@
+"""ActorPool: load-balanced work distribution over a fixed actor set.
+
+Parity: reference ``python/ray/util/actor_pool.py`` — submit/map/
+map_unordered/get_next/get_next_unordered/has_next over a pool of actor
+handles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order. A task exception is raised to
+        the caller but the slot is consumed and the actor recycled (the
+        pool stays usable); a get TIMEOUT leaves the pool untouched."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        # Invariant: ordered consumption + FIFO pending submission means the
+        # next-to-return task is always already submitted (each earlier
+        # consumption recycled an actor, which submitted the next pending).
+        idx = self._next_return_index
+        ref = self._index_to_future[idx]
+        try:
+            value = ray_tpu.get(ref, timeout=timeout)
+        except Exception as e:
+            from ray_tpu.exceptions import GetTimeoutError
+
+            if isinstance(e, GetTimeoutError):
+                raise  # state untouched: retryable
+            self._consume(idx, ref)
+            raise
+        self._consume(idx, ref)
+        return value
+
+    def _consume(self, idx: int, ref) -> None:
+        self._index_to_future.pop(idx, None)
+        self._next_return_index = idx + 1
+        _, actor = self._future_to_actor.pop(ref)
+        self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in COMPLETION order. Task exceptions are raised
+        after the actor is recycled, so the pool survives failures."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        refs = list(self._future_to_actor)
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        idx, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(idx, None)
+        self._return_actor(actor)  # recycle BEFORE get: failures keep pool
+        return ray_tpu.get(ref)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    @property
+    def num_idle(self) -> int:
+        return len(self._idle)

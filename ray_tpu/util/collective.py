@@ -1,0 +1,248 @@
+"""Collective communication API — the ray.util.collective equivalent.
+
+Parity: reference ``python/ray/util/collective/collective.py:40`` —
+``init_collective_group``, ``allreduce:258``, ``broadcast:373``,
+``allgather:423``, ``reducescatter:472``, ``send/recv:531,594`` over NCCL/
+Gloo. TPU mapping (SURVEY §5.8): INSIDE jitted code, collectives are XLA
+ops compiled over ICI — use :func:`in_graph` verbs (thin, documented
+aliases of ``jax.lax.p*``) under ``shard_map``. BETWEEN host processes
+(out-of-band, the NCCL-out-of-CUDA-graph role), the verbs below move host
+arrays through the object plane via a named rendezvous actor — the same
+named-actor rendezvous trick the reference uses for the NCCL unique id
+(``collective/util.py:9``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_DEFAULT_GROUP = "default"
+
+
+class _Rendezvous:
+    """Named actor: barrier + value exchange for one collective group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._round: Dict[str, Dict[int, Any]] = {}
+        self._done_counts: Dict[str, int] = {}
+
+    def put(self, op_id: str, rank: int, value) -> bool:
+        self._round.setdefault(op_id, {})[rank] = value
+        return len(self._round[op_id]) == self.world_size
+
+    def ready(self, op_id: str) -> bool:
+        return len(self._round.get(op_id, {})) == self.world_size
+
+    def gather(self, op_id: str) -> Optional[List]:
+        vals = self._round.get(op_id)
+        if vals is None or len(vals) < self.world_size:
+            return None
+        out = [vals[r] for r in range(self.world_size)]
+        # reclaim after every rank has fetched
+        self._done_counts[op_id] = self._done_counts.get(op_id, 0) + 1
+        if self._done_counts[op_id] >= self.world_size:
+            del self._round[op_id]
+            del self._done_counts[op_id]
+        return out
+
+    def put_p2p(self, key: str, value) -> bool:
+        # FIFO per (src,dst,tag) channel: back-to-back sends are ordered and
+        # lossless (NCCL/Gloo send/recv semantics, the parity target)
+        self._round.setdefault("p2p", {}).setdefault(key, []).append(value)
+        return True
+
+    def take_p2p(self, key: str):
+        chan = self._round.setdefault("p2p", {}).get(key)
+        if chan:
+            return [chan.pop(0)]
+        return None
+
+    def world(self) -> int:
+        return self.world_size
+
+
+class CollectiveGroup:
+    """Handle bound to (group_name, rank)."""
+
+    def __init__(self, name: str, rank: int, world_size: int, actor):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self._actor = actor
+        self._seq = 0
+
+    def _next_op(self, verb: str) -> str:
+        self._seq += 1
+        return f"{verb}:{self._seq}"
+
+    def _exchange(self, op_id: str, value, timeout: float) -> List:
+        import time
+
+        ray_tpu.get(
+            self._actor.put.remote(op_id, self.rank, value), timeout=timeout
+        )
+        deadline = time.monotonic() + timeout
+        while True:
+            out = ray_tpu.get(self._actor.gather.remote(op_id),
+                              timeout=timeout)
+            if out is not None:
+                return out
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {op_id} timed out waiting for "
+                    f"{self.world_size} ranks in group {self.name!r}"
+                )
+            time.sleep(0.005)
+
+    # -- verbs (parity: collective.py allreduce:258 etc.) --
+
+    def allreduce(self, tensor: np.ndarray, op: str = "sum",
+                  timeout: float = 120.0) -> np.ndarray:
+        parts = self._exchange(self._next_op("allreduce"), tensor, timeout)
+        stack = np.stack([np.asarray(p) for p in parts])
+        if op == "sum":
+            return stack.sum(0)
+        if op == "mean":
+            return stack.mean(0)
+        if op == "max":
+            return stack.max(0)
+        if op == "min":
+            return stack.min(0)
+        raise ValueError(f"unknown reduce op {op!r}")
+
+    def broadcast(self, tensor: Optional[np.ndarray], src: int = 0,
+                  timeout: float = 120.0) -> np.ndarray:
+        payload = tensor if self.rank == src else None
+        parts = self._exchange(self._next_op("broadcast"), payload, timeout)
+        return np.asarray(parts[src])
+
+    def allgather(self, tensor: np.ndarray,
+                  timeout: float = 120.0) -> List[np.ndarray]:
+        parts = self._exchange(self._next_op("allgather"), tensor, timeout)
+        return [np.asarray(p) for p in parts]
+
+    def reducescatter(self, tensor: np.ndarray, op: str = "sum",
+                      timeout: float = 120.0) -> np.ndarray:
+        """Each rank gets its 1/world_size slice of the reduction (axis 0;
+        length must divide world_size)."""
+        reduced = self.allreduce(tensor, op=op, timeout=timeout)
+        n = reduced.shape[0]
+        if n % self.world_size:
+            raise ValueError(
+                f"reducescatter axis-0 length {n} not divisible by "
+                f"world_size {self.world_size}"
+            )
+        per = n // self.world_size
+        return reduced[self.rank * per: (self.rank + 1) * per]
+
+    def barrier(self, timeout: float = 120.0) -> None:
+        self._exchange(self._next_op("barrier"), None, timeout)
+
+    def send(self, tensor: np.ndarray, dst: int, tag: int = 0,
+             timeout: float = 120.0) -> None:
+        key = f"{self.rank}->{dst}:{tag}"
+        ray_tpu.get(self._actor.put_p2p.remote(key, tensor), timeout=timeout)
+
+    def recv(self, src: int, tag: int = 0,
+             timeout: float = 120.0) -> np.ndarray:
+        import time
+
+        key = f"{src}->{self.rank}:{tag}"
+        deadline = time.monotonic() + timeout
+        while True:
+            out = ray_tpu.get(self._actor.take_p2p.remote(key),
+                              timeout=timeout)
+            if out is not None:
+                return np.asarray(out[0])
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"recv from rank {src} tag {tag} timed out")
+            time.sleep(0.005)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = _DEFAULT_GROUP) -> CollectiveGroup:
+    """Join (rank 0 creates) a collective group. Call once per process
+    (parity: init_collective_group:120 / the NCCLUniqueIDStore rendezvous)."""
+    actor_name = f"__collective_{group_name}"
+    actor = None
+    if rank == 0:
+        cls = ray_tpu.remote(num_cpus=0.1, name=actor_name)(_Rendezvous)
+        try:
+            actor = cls.remote(world_size)
+        except Exception:
+            actor = None
+    if actor is None:
+        import time
+
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                actor = ray_tpu.get_actor(actor_name)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+    existing_world = ray_tpu.get(actor.world.remote(), timeout=60)
+    if existing_world != world_size:
+        raise ValueError(
+            f"collective group {group_name!r} already exists with "
+            f"world_size={existing_world} (requested {world_size}); use a "
+            f"distinct group_name"
+        )
+    return CollectiveGroup(group_name, rank, world_size, actor)
+
+
+# ---------------------------------------------------------------------------
+# In-graph verbs: inside jit/shard_map these ARE the collectives — XLA
+# compiles them onto ICI. Documented aliases so users find them here.
+# ---------------------------------------------------------------------------
+
+class in_graph:
+    """Use inside ``shard_map``: ``in_graph.allreduce(x, 'dp')`` etc."""
+
+    @staticmethod
+    def allreduce(x, axis_name: str):
+        import jax
+
+        return jax.lax.psum(x, axis_name)
+
+    @staticmethod
+    def mean(x, axis_name: str):
+        import jax
+
+        return jax.lax.pmean(x, axis_name)
+
+    @staticmethod
+    def allgather(x, axis_name: str, axis: int = 0):
+        import jax
+
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+    @staticmethod
+    def reducescatter(x, axis_name: str, axis: int = 0):
+        import jax
+
+        return jax.lax.psum_scatter(
+            x, axis_name, scatter_dimension=axis, tiled=True
+        )
+
+    @staticmethod
+    def permute(x, axis_name: str, perm):
+        import jax
+
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+        import jax
+
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            tiled=True,
+        )

@@ -162,3 +162,59 @@ def test_log_to_driver(rt_obs, capfd):
             break
         time.sleep(0.3)
     assert "hello-from-worker-xyz" in seen
+
+def test_out_of_band_collectives(rt_obs):
+    """Collective verbs between host actors over the object plane
+    (component parity: ray.util.collective NCCL/Gloo groups)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util.collective import init_collective_group
+
+            self.g = init_collective_group(world, rank, "testgrp")
+            self.rank = rank
+
+        def do_allreduce(self):
+            out = self.g.allreduce(np.full(4, self.rank + 1.0))
+            return out.tolist()
+
+        def do_broadcast(self):
+            val = np.arange(3.0) if self.rank == 0 else None
+            return self.g.broadcast(val, src=0).tolist()
+
+        def do_allgather(self):
+            return [x.tolist() for x in self.g.allgather(
+                np.full(2, float(self.rank)))]
+
+        def do_reducescatter(self):
+            return self.g.reducescatter(
+                np.arange(4.0) * (self.rank + 1)).tolist()
+
+        def do_p2p(self):
+            if self.rank == 0:
+                self.g.send(np.full(2, 7.0), dst=1)
+                return None
+            return self.g.recv(src=0).tolist()
+
+    r0 = Rank.remote(0, 2)
+    r1 = Rank.remote(1, 2)
+    a, b = ray_tpu.get([r0.do_allreduce.remote(), r1.do_allreduce.remote()],
+                       timeout=120)
+    assert a == b == [3.0] * 4  # 1 + 2
+    a, b = ray_tpu.get([r0.do_broadcast.remote(), r1.do_broadcast.remote()],
+                       timeout=120)
+    assert a == b == [0.0, 1.0, 2.0]
+    a, b = ray_tpu.get([r0.do_allgather.remote(), r1.do_allgather.remote()],
+                       timeout=120)
+    assert a == b == [[0.0, 0.0], [1.0, 1.0]]
+    a, b = ray_tpu.get(
+        [r0.do_reducescatter.remote(), r1.do_reducescatter.remote()],
+        timeout=120,
+    )
+    # sum = arange(4)*1 + arange(4)*2 = [0,3,6,9]; rank0 gets [0,3], rank1 [6,9]
+    assert a == [0.0, 3.0] and b == [6.0, 9.0]
+    _, recv = ray_tpu.get([r0.do_p2p.remote(), r1.do_p2p.remote()],
+                          timeout=120)
+    assert recv == [7.0, 7.0]
