@@ -228,6 +228,7 @@ class CoreWorker:
         self._actor_state_cache: Dict[bytes, str] = {}
         self._actor_seq: Dict[bytes, int] = collections.defaultdict(int)
         self._actor_pinned: Dict[bytes, List] = {}
+        self._actor_conc_cache: Dict[bytes, int] = {}
         self._actor_queues: Dict[bytes, collections.deque] = (
             collections.defaultdict(collections.deque)
         )
@@ -237,6 +238,11 @@ class CoreWorker:
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._actor_instance = None
         self._actor_id: Optional[bytes] = None
+        self._actor_concurrency = 1
+        self._actor_is_async = False  # class defines async-def methods
+        self._actor_threads = None  # ThreadPoolExecutor when concurrency > 1
+        self._actor_aio_loop = None  # asyncio loop for async-def methods
+        self._actor_aio_sem = None
         self._current_task_name = ""
         self._shutdown = threading.Event()
         # task-event buffer (batched to the GCS task manager)
@@ -792,6 +798,7 @@ class CoreWorker:
         retry_exceptions: bool = False,
         scheduling_strategy=None,
         pinned=None,
+        runtime_env: Optional[Dict] = None,
     ) -> List[ObjectRef]:
         fid = self._export("fn", fn)
         task_id = TaskID.for_task()
@@ -811,6 +818,7 @@ class CoreWorker:
             retry_exceptions=retry_exceptions,
             owner=self.address.to_wire(),
             scheduling_strategy=scheduling_strategy,
+            runtime_env=self._process_runtime_env(runtime_env),
         )
         refs = []
         for oid in spec.return_ids():
@@ -1143,6 +1151,7 @@ class CoreWorker:
         scheduling_strategy=None,
         pinned=None,
         method_meta: Optional[Dict] = None,
+        runtime_env: Optional[Dict] = None,
     ) -> bytes:
         cid = self._export("cls", cls)
         actor_id = ActorID.from_random().binary()
@@ -1161,6 +1170,7 @@ class CoreWorker:
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
             scheduling_strategy=scheduling_strategy,
+            runtime_env=self._process_runtime_env(runtime_env),
         )
         wire = spec.to_wire()
         wire["name_register"] = actor_name
@@ -1170,6 +1180,7 @@ class CoreWorker:
         reply = self.gcs.call("create_actor", wire)
         if not reply.get("ok"):
             raise ValueError(reply.get("error", "actor creation failed"))
+        self._actor_conc_cache[actor_id] = max(1, max_concurrency)
         return actor_id
 
     def submit_actor_task(
@@ -1211,7 +1222,23 @@ class CoreWorker:
         """Per-actor FIFO: submission-order execution per caller (parity:
         reference sequential actor submit queues, direct_actor_task_submitter).
         One pump per actor awaits each task fully before the next, so a task
-        stuck resolving a dependency can't be overtaken by a later call."""
+        stuck resolving a dependency can't be overtaken by a later call.
+
+        Actors declared with max_concurrency > 1 opt OUT of ordering
+        (reference semantics): their tasks are pushed without waiting for
+        earlier replies, so the executor's thread pool / asyncio loop can
+        actually interleave them."""
+        if spec.actor_id not in self._actor_conc_cache:
+            # handle arrived from elsewhere (arg / get_actor): fetch the
+            # record first — choosing the ordered pump for a concurrent
+            # actor would serialize (or deadlock) wait/signal patterns
+            await self._actor_address(spec.actor_id)
+            self._actor_conc_cache.setdefault(spec.actor_id, 1)
+        if self._actor_conc_cache.get(spec.actor_id, 1) > 1:
+            asyncio.get_running_loop().create_task(
+                self._submit_actor_async(spec)
+            )
+            return
         q = self._actor_queues[spec.actor_id]
         q.append(spec)
         if spec.actor_id in self._actor_pumping:
@@ -1231,6 +1258,10 @@ class CoreWorker:
             if rec is None:
                 return None
             self._actor_state_cache[actor_id] = rec["state"]
+            if "max_concurrency" in rec:
+                self._actor_conc_cache[actor_id] = max(
+                    1, rec["max_concurrency"] or 1
+                )
             if rec["state"] == "ALIVE" and rec["address"]:
                 self._actor_addr_cache[actor_id] = rec["address"]
                 return rec["address"]
@@ -1364,6 +1395,9 @@ class CoreWorker:
         rec = self.gcs.call("get_named_actor", name)
         if rec is None or rec["state"] == "DEAD":
             raise ValueError(f"Failed to look up actor with name {name!r}")
+        self._actor_conc_cache[bytes(rec["actor_id"])] = max(
+            1, rec.get("max_concurrency", 1) or 1
+        )
         return rec
 
     # ================= execution (worker side) =================
@@ -1385,7 +1419,15 @@ class CoreWorker:
         return {"ok": True}
 
     def execution_loop(self):
-        """Run on the worker's MAIN thread (owns JAX/device runtime)."""
+        """Run on the worker's MAIN thread (owns JAX/device runtime).
+
+        Plain tasks and concurrency-1 sync actor methods execute inline.
+        Actors created with max_concurrency > 1 dispatch methods to a
+        thread pool; async-def methods run on a dedicated asyncio loop
+        (parity: reference BoundedExecutor thread_pool.h:36 and the
+        boost::fibers async-actor path fiber.h — asyncio instead)."""
+        import inspect
+
         while not self._shutdown.is_set():
             self._prune_handoff_pins()
             try:
@@ -1393,10 +1435,208 @@ class CoreWorker:
             except queue_mod.Empty:
                 continue
             spec, fut, loop = item
-            reply = self._execute(spec)
-            loop.call_soon_threadsafe(
-                lambda f=fut, r=reply: (not f.done()) and f.set_result(r)
+
+            def reply_to(r, f=fut, lp=loop):
+                lp.call_soon_threadsafe(
+                    lambda: (not f.done()) and f.set_result(r)
+                )
+
+            is_plain_method = (
+                spec.actor_id is not None
+                and not spec.actor_creation
+                and self._actor_instance is not None
             )
+            if is_plain_method:
+                if self._actor_is_async:
+                    # ALL methods of an async actor route through its aio
+                    # loop (sync ones via to_thread inside) so the
+                    # max_concurrency semaphore governs every method —
+                    # otherwise a sync method would run on this thread
+                    # concurrently with a suspended coroutine.
+                    self._run_async_method(spec, reply_to)
+                    continue
+                if self._actor_threads is not None:
+                    self._actor_threads.submit(
+                        lambda s=spec, cb=reply_to: cb(self._execute(s))
+                    )
+                    continue
+            reply_to(self._execute(spec))
+
+    def _ensure_actor_aio(self):
+        if self._actor_aio_loop is None:
+            loop = asyncio.new_event_loop()
+
+            def run():
+                asyncio.set_event_loop(loop)
+                loop.run_forever()
+
+            threading.Thread(target=run, daemon=True,
+                             name="actor-asyncio").start()
+            self._actor_aio_loop = loop
+            self._actor_aio_sem = None  # built lazily on the loop
+
+    def _run_async_method(self, spec: TaskSpec, reply_to):
+        """Schedule an async-def actor method on the actor's asyncio loop;
+        up to max_concurrency coroutines run interleaved."""
+        self._ensure_actor_aio()
+
+        import inspect
+
+        async def run():
+            if self._actor_aio_sem is None:
+                self._actor_aio_sem = asyncio.Semaphore(
+                    max(1, self._actor_concurrency)
+                )
+            async with self._actor_aio_sem:
+                self._emit_task_event(spec, "RUNNING")
+                try:
+                    method = getattr(self._actor_instance, spec.method_name)
+                    args, kwargs = self._unpack_args(self._decode_args(spec))
+                    if inspect.iscoroutinefunction(method):
+                        result = await method(*args, **kwargs)
+                    else:
+                        # sync method of an async actor: off the loop so
+                        # coroutines keep interleaving, still semaphore-capped
+                        result = await asyncio.to_thread(
+                            method, *args, **kwargs
+                        )
+                    out = self._encode_returns(spec, result)
+                    self._emit_task_event(spec, "FINISHED")
+                    return out
+                except Exception as e:  # noqa: BLE001 — shipped to caller
+                    return self._error_reply(spec, e)
+
+        cf = asyncio.run_coroutine_threadsafe(run(), self._actor_aio_loop)
+
+        def done(c):
+            try:
+                r = c.result()
+            except BaseException as e:  # cancelled loop, pack failure, ...
+                r = self._error_reply(spec, e)
+            reply_to(r)
+
+        cf.add_done_callback(done)
+
+    # ================= runtime envs =================
+    # Parity: reference runtime_env (env_vars + working_dir zipped through
+    # the GCS KV and cached per node — python/ray/_private/runtime_env/
+    # working_dir.py). conda/pip/containers are out of scope (no installs
+    # in this environment); unknown keys raise.
+
+    _RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+
+    def _process_runtime_env(self, runtime_env: Optional[Dict]) -> Optional[Dict]:
+        """Driver side: validate + upload working_dir; returns wire form."""
+        if not runtime_env:
+            return None
+        unknown = set(runtime_env) - self._RUNTIME_ENV_KEYS
+        if unknown:
+            raise ValueError(
+                f"unsupported runtime_env keys {sorted(unknown)} "
+                f"(supported: {sorted(self._RUNTIME_ENV_KEYS)})"
+            )
+        wire: Dict = {}
+        env_vars = runtime_env.get("env_vars")
+        if env_vars:
+            wire["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+        wdir = runtime_env.get("working_dir")
+        if wdir:
+            if not os.path.isdir(wdir):
+                raise ValueError(
+                    f"runtime_env working_dir {wdir!r} is not a directory"
+                )
+            import io
+            import zipfile
+
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+                for root, dirs, files in os.walk(wdir):
+                    dirs[:] = [d for d in dirs if d != "__pycache__"]
+                    for f in files:
+                        full = os.path.join(root, f)
+                        zf.write(full, os.path.relpath(full, wdir))
+            blob = buf.getvalue()
+            key = "wdir:" + hashlib.sha256(blob).hexdigest()[:24]
+            if not self.gcs.call("kv_exists", key):
+                self.gcs.call("kv_put", [key, blob, False])
+            wire["working_dir_key"] = key
+        return wire or None
+
+    def _materialize_working_dir(self, key: str) -> str:
+        """Worker side: download + extract once per node (content-addressed)."""
+        cache = os.path.join(self.session_dir, "runtime_env",
+                             key.split(":", 1)[1])
+        if os.path.isdir(cache):
+            return cache
+        blob = self.gcs.call("kv_get", key)
+        if blob is None:
+            raise RuntimeError(f"runtime_env working_dir {key} missing")
+        import io
+        import zipfile
+
+        tmp = cache + f".tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(bytes(blob))) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, cache)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # racer won
+        return cache
+
+    def _apply_runtime_env(self, spec: TaskSpec, permanent: bool = False):
+        """Apply env_vars/working_dir; returns a restore callable (no-op
+        when permanent — actor creation keeps its env for life)."""
+        renv = spec.runtime_env
+        if not renv:
+            return lambda: None
+        saved_env: Dict[str, Optional[str]] = {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        saved_cwd = None
+        added_path = None
+        key = renv.get("working_dir_key")
+        if key:
+            path = self._materialize_working_dir(key)
+            saved_cwd = os.getcwd()
+            os.chdir(path)
+            import sys as _sys
+
+            _sys.path.insert(0, path)
+            added_path = path
+        if permanent:
+            return lambda: None
+
+        def restore():
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
+            if added_path is not None:
+                import sys as _sys
+
+                try:
+                    _sys.path.remove(added_path)
+                except ValueError:
+                    pass
+                # evict modules imported FROM the working_dir: a later task
+                # with a different working_dir must not see stale code
+                for mod_name in [
+                    m for m, mod in list(_sys.modules.items())
+                    if getattr(mod, "__file__", None)
+                    and str(getattr(mod, "__file__")).startswith(
+                        added_path + os.sep
+                    )
+                ]:
+                    _sys.modules.pop(mod_name, None)
+
+        return restore
 
     def _decode_args(self, spec: TaskSpec):
         args = []
@@ -1415,11 +1655,27 @@ class CoreWorker:
         self._emit_task_event(spec, "RUNNING")
         try:
             if spec.actor_creation:
+                # actor runtime env persists for the actor's lifetime
+                self._apply_runtime_env(spec, permanent=True)
                 cls_info = self._fetch("cls", spec.function_id, spec.job_id)
                 args, kwargs = self._unpack_args(self._decode_args(spec))
                 cls = cls_info
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_id = spec.actor_id
+                self._actor_concurrency = max(1, spec.max_concurrency or 1)
+                import inspect as _inspect
+
+                self._actor_is_async = any(
+                    _inspect.iscoroutinefunction(m)
+                    for _, m in _inspect.getmembers(type(self._actor_instance))
+                )
+                if self._actor_concurrency > 1 and not self._actor_is_async:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._actor_threads = ThreadPoolExecutor(
+                        max_workers=self._actor_concurrency,
+                        thread_name_prefix="actor-exec",
+                    )
                 return {"returns": []}
             if spec.actor_id:
                 if self._actor_instance is None:
@@ -1430,21 +1686,39 @@ class CoreWorker:
             else:
                 fn = self._fetch("fn", spec.function_id, spec.job_id)
                 args, kwargs = self._unpack_args(self._decode_args(spec))
-                result = fn(*args, **kwargs)
+                restore_env = self._apply_runtime_env(spec)
+                try:
+                    result = fn(*args, **kwargs)
+                finally:
+                    restore_env()
             out = self._encode_returns(spec, result)
             self._emit_task_event(spec, "FINISHED")
             return out
         except Exception as e:
-            tb = traceback.format_exc()
-            self._emit_task_event(spec, "FAILED", error=str(e))
-            err = exc.TaskError(
-                function_name=spec.name, traceback_str=tb, cause=None
-            )
-            packed = serialization.pack(exc.ErrorObject(err))
-            returns = [["v", packed] for _ in range(spec.num_returns)]
-            return {"returns": returns, "error": str(e)}
+            return self._error_reply(spec, e)
         finally:
             self._current_task_name = ""
+
+    def _error_reply(self, spec: TaskSpec, e: BaseException) -> Dict:
+        tb = traceback.format_exc()
+        self._emit_task_event(spec, "FAILED", error=str(e))
+        err = exc.TaskError(
+            function_name=spec.name, traceback_str=tb, cause=None
+        )
+        try:
+            packed = serialization.pack(exc.ErrorObject(err))
+        except Exception:  # exotic unpicklable failure: degrade to text
+            packed = serialization.pack(
+                exc.ErrorObject(
+                    exc.TaskError(
+                        function_name=spec.name,
+                        traceback_str=f"{type(e).__name__}: {e}",
+                        cause=None,
+                    )
+                )
+            )
+        returns = [["v", packed] for _ in range(spec.num_returns)]
+        return {"returns": returns, "error": str(e)}
 
     @staticmethod
     def _unpack_args(decoded):

@@ -104,6 +104,7 @@ class ActorRecord:
             "name": self.name,
             "death_cause": self.death_cause,
             "method_meta": self.spec.get("method_meta") or {},
+            "max_concurrency": self.spec.get("max_concurrency", 1),
         }
 
 

@@ -129,6 +129,7 @@ class ActorClass:
             scheduling_strategy=_encode_strategy(
                 opts.get("scheduling_strategy")
             ),
+            runtime_env=opts.get("runtime_env"),
             pinned=pinned,
             method_meta=meta,
         )

@@ -55,6 +55,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries"),
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=_encode_strategy(opts.get("scheduling_strategy")),
+            runtime_env=opts.get("runtime_env"),
             pinned=pinned,
         )
         if opts.get("num_returns", 1) == 1:
