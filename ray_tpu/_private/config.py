@@ -101,5 +101,8 @@ _d("metrics_report_interval_ms", int, 2000)
 _d("object_spilling_enabled", bool, True)
 _d("object_spilling_threshold", float, 0.8)
 _d("log_to_driver", bool, True)
+# "memory" | "file": file-backed GCS tables reload across GCS restarts
+# (reference Redis-backed GCS FT, redis_store_client.h:33)
+_d("gcs_storage_backend", str, "memory")
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)

@@ -182,6 +182,7 @@ class CoreWorker:
         self.my_addr = self.server.addr
         self.address = Address(worker_id, self.my_addr, node_id)
 
+        self.gcs_addr = gcs_addr
         self.gcs = rpc.Client.connect(
             gcs_addr, handler=rpc.handler_table(self), name="->gcs"
         )
@@ -271,6 +272,16 @@ class CoreWorker:
                 self.gcs.call("subscribe", ["logs"])
             except Exception:
                 pass
+
+            # a restarted GCS loses its subscriber registry: replay on
+            # reconnect (direct conn call — call() would re-enter the
+            # reconnect lock)
+            def _resub(client):
+                client.io.run(
+                    client.conn.call_async("subscribe", ["logs"], timeout=10)
+                )
+
+            self.gcs.on_reconnect = _resub
         if GLOBAL_CONFIG.task_events_enabled:
             async def _event_flusher():
                 while not self._shutdown.is_set():

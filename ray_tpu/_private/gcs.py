@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Set
 
@@ -109,8 +110,14 @@ class ActorRecord:
 
 
 class GcsServer:
-    def __init__(self, sock_path: str):
+    def __init__(self, sock_path: str, storage_path: Optional[str] = None):
         self.sock_path = sock_path
+        # file-backed table persistence (parity: reference Redis GCS FT,
+        # gcs_table_storage.h:252 / redis_store_client.h:33): KV + jobs
+        # reload across GCS restarts; runtime state (nodes, actors) is
+        # re-established by raylets re-registering.
+        self.storage_path = storage_path
+        self._dirty = False
         self.server = rpc.Server(sock_path, rpc.handler_table(self), name="gcs")
         # tables
         self.kv: Dict[str, bytes] = {}
@@ -130,16 +137,74 @@ class GcsServer:
 
     # ---------------- lifecycle ----------------
     async def start(self):
+        self._load_storage()
         await self.server.start_async()
-        self._health_task = asyncio.get_running_loop().create_task(
-            self._health_loop()
-        )
+        loop = asyncio.get_running_loop()
+        self._health_task = loop.create_task(self._health_loop())
+        if self.storage_path:
+            self._persist_task = loop.create_task(self._persist_loop())
         self._started.set()
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if getattr(self, "_persist_task", None):
+            self._persist_task.cancel()
+            self._persist_now()
         await self.server.stop_async()
+
+    # ---------------- persistence (file backend) ----------------
+
+    def _load_storage(self):
+        if not self.storage_path or not os.path.exists(self.storage_path):
+            return
+        import pickle
+
+        try:
+            with open(self.storage_path, "rb") as f:
+                snap = pickle.load(f)
+            self.kv = snap.get("kv", {})
+            self.jobs = snap.get("jobs", {})
+            logger.info(
+                "restored GCS tables from %s (%d kv keys, %d jobs)",
+                self.storage_path, len(self.kv), len(self.jobs),
+            )
+        except Exception:
+            logger.exception("failed to load GCS storage; starting empty")
+
+    def _mark_dirty(self):
+        self._dirty = True
+
+    def _snapshot(self) -> Dict:
+        """Copy tables ON the event-loop thread (no concurrent mutation) and
+        clear the dirty flag atomically with the copy — a put landing after
+        this is a NEW dirty state."""
+        self._dirty = False
+        return {"kv": dict(self.kv), "jobs": dict(self.jobs)}
+
+    def _write_snapshot(self, snap: Dict):
+        import pickle
+
+        tmp = self.storage_path + f".tmp.{os.urandom(4).hex()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f, protocol=5)
+        os.replace(tmp, self.storage_path)
+
+    def _persist_now(self):
+        if self.storage_path:
+            self._write_snapshot(self._snapshot())
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            if self._dirty:
+                snap = self._snapshot()  # loop thread: consistent copy
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._write_snapshot, snap
+                    )
+                except Exception:
+                    logger.exception("GCS persistence flush failed")
 
     # ---------------- pubsub ----------------
     def _publish(self, channel: str, data: Any):
@@ -175,12 +240,14 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return False
         self.kv[key] = value
+        self._mark_dirty()
         return True
 
     async def rpc_kv_get(self, conn, key):
         return self.kv.get(key)
 
     async def rpc_kv_del(self, conn, key):
+        self._mark_dirty()
         return self.kv.pop(key, None) is not None
 
     async def rpc_kv_exists(self, conn, key):
@@ -277,6 +344,7 @@ class GcsServer:
     async def rpc_register_job(self, conn, data):
         job_id, meta = data
         self.jobs[job_id] = dict(meta, start_time=time.time())
+        self._mark_dirty()
         return True
 
     async def rpc_get_jobs(self, conn, _):
@@ -432,6 +500,34 @@ class GcsServer:
         else:
             rec.death_cause = reason
             await self._fail_actor(rec, reason)
+
+    async def rpc_restore_actors(self, conn, hosted: List[Dict]):
+        """A (re-)registering raylet replays its live actors so a restarted
+        GCS rebuilds its actor/named-actor tables (GCS FT — the reference
+        recovers this from Redis; here the raylets ARE the durable source
+        for runtime state)."""
+        restored = 0
+        for item in hosted:
+            spec = item["spec"]
+            actor_id = bytes(spec["actor_id"])
+            if actor_id in self.actors:
+                continue
+            name = spec.get("name_register") or ""
+            rec = ActorRecord(actor_id, spec, name=name)
+            rec.state = ALIVE
+            rec.address = item["address"]
+            self.actors[actor_id] = rec
+            if name:
+                self.named_actors.setdefault(name, actor_id)
+            restored += 1
+        if restored:
+            logger.info("restored %d live actor(s) from a raylet", restored)
+            self._publish(
+                "actors",
+                [self.actors[bytes(i["spec"]["actor_id"])].to_wire()
+                 for i in hosted],
+            )
+        return restored
 
     async def rpc_report_actor_death(self, conn, data):
         """Raylet reports an actor worker exited."""
@@ -808,6 +904,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sock")
     p.add_argument("--config", default="")
+    p.add_argument("--storage", default="")
     args = p.parse_args()
     logging.basicConfig(
         level=logging.INFO,
@@ -820,7 +917,7 @@ def main():
         GLOBAL_CONFIG.load(json.loads(args.config))
 
     async def run():
-        gcs = GcsServer(args.sock)
+        gcs = GcsServer(args.sock, storage_path=args.storage or None)
         await gcs.start()
         await asyncio.Event().wait()  # serve forever
 

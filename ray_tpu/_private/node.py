@@ -175,13 +175,39 @@ class Cluster:
             raise RuntimeError("joined an external GCS; not starting one")
         if self.use_tcp:
             self._gcs_addr = f"tcp:{self.node_ip}:{pick_free_port(self.node_ip)}"
-        cfg = json.dumps(GLOBAL_CONFIG.dump()) if system_config is None else (
-            json.dumps({**GLOBAL_CONFIG.dump(), **system_config})
-        )
+        cfg_dict = dict(GLOBAL_CONFIG.dump())
+        if system_config:
+            cfg_dict.update(system_config)
+        self._gcs_cmd = [
+            sys.executable, "-m", "ray_tpu._private.gcs",
+            "--sock", self.gcs_addr, "--config", json.dumps(cfg_dict),
+        ]
+        if cfg_dict.get("gcs_storage_backend") == "file":
+            self._gcs_cmd += [
+                "--storage", os.path.join(self.session_dir, "gcs_storage.pkl"),
+            ]
         self.gcs_proc = _spawn(
-            [sys.executable, "-m", "ray_tpu._private.gcs",
-             "--sock", self.gcs_addr, "--config", cfg],
+            self._gcs_cmd,
             os.path.join(self.session_dir, "logs", "gcs.log"),
+        )
+        _wait_addr(self.gcs_addr, proc=self.gcs_proc)
+
+    def restart_gcs(self):
+        """Kill + restart the GCS process (FT testing: with the file storage
+        backend, tables reload and raylets re-register)."""
+        if self.gcs_proc.poll() is None:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait()
+        # unix sockets must be unlinked before rebinding
+        if self.gcs_addr.startswith("unix:") or self.gcs_addr.startswith("/"):
+            path = self.gcs_addr.split(":", 1)[-1]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self.gcs_proc = _spawn(
+            self._gcs_cmd,
+            os.path.join(self.session_dir, "logs", "gcs-restarted.log"),
         )
         _wait_addr(self.gcs_addr, proc=self.gcs_proc)
 

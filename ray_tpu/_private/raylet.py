@@ -116,6 +116,9 @@ class Raylet:
         self.spilled_bytes = 0
         self._spilling: Set[bytes] = set()  # oids with an in-flight spill
         self._ever_workers: Set[bytes] = set()  # for log tailing after death
+        # live actors hosted here: actor_id -> {"spec", "address"} — replayed
+        # to a restarted GCS so its actor table survives (GCS FT)
+        self.hosted_actors: Dict[bytes, Dict] = {}
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -127,24 +130,7 @@ class Raylet:
             # full creates escalate to spill_now instead of dropping LRU data
             self.store.set_no_evict(True)
         await self.server.start_async()
-        self.gcs = await self._connect_gcs()
-        reply = await self.gcs.call_async(
-            "register_node",
-            NodeInfo(
-                node_id=self.node_id,
-                raylet_addr=self.server.addr,
-                store_path=self.store_path,
-                resources=self.total_resources,
-                labels=self.labels,
-            ).to_wire(),
-        )
-        GLOBAL_CONFIG.load(reply["config"])
-        snap = await self.gcs.call_async(
-            "subscribe", ["nodes", "resources"]
-        )
-        for n in snap.get("nodes", []):
-            self._on_nodes_update([n])
-        self.cluster_resources = snap.get("resources") or {}
+        await self._register_with_gcs()
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._memory_monitor_loop()))
@@ -171,6 +157,53 @@ class Raylet:
         return await rpc.connect_async(
             self.gcs_addr, rpc.handler_table(self), timeout=30, name="raylet->gcs"
         )
+
+    async def _register_with_gcs(self):
+        """Connect + register + subscribe; re-armed on connection loss so a
+        restarted GCS (file-backed FT) gets this node back (parity:
+        reference NotifyGCSRestart + raylet re-registration,
+        node_manager.proto:358)."""
+        self.gcs = await self._connect_gcs()
+        reply = await self.gcs.call_async(
+            "register_node",
+            NodeInfo(
+                node_id=self.node_id,
+                raylet_addr=self.server.addr,
+                store_path=self.store_path,
+                resources=self.total_resources,
+                labels=self.labels,
+            ).to_wire(),
+        )
+        GLOBAL_CONFIG.load(reply["config"])
+        snap = await self.gcs.call_async("subscribe", ["nodes", "resources"])
+        for n in snap.get("nodes", []):
+            self._on_nodes_update([n])
+        self.cluster_resources = snap.get("resources") or {}
+        if self.hosted_actors:
+            # replay live actors into the (possibly restarted) GCS table
+            try:
+                await self.gcs.call_async(
+                    "restore_actors", list(self.hosted_actors.values()),
+                    timeout=30,
+                )
+            except Exception:
+                logger.warning("actor-table replay to GCS failed")
+        self.gcs.add_close_callback(self._on_gcs_conn_lost)
+
+    def _on_gcs_conn_lost(self, conn):
+        if self._stopping:
+            return
+        logger.warning("GCS connection lost; reconnecting...")
+        asyncio.get_running_loop().create_task(self._gcs_reconnect_loop())
+
+    async def _gcs_reconnect_loop(self):
+        while not self._stopping:
+            try:
+                await self._register_with_gcs()
+                logger.info("re-registered with restarted GCS")
+                return
+            except Exception:
+                await asyncio.sleep(1.0)
 
     # ------------- pubsub from GCS -------------
     async def rpc_publish(self, conn, data):
@@ -315,6 +348,8 @@ class Raylet:
                 if s is not None:
                     s.discard(lease.lease_id)
             self._release_alloc(lease.alloc, lease.resources)
+        if w.actor_id is not None:
+            self.hosted_actors.pop(w.actor_id, None)
         if w.actor_id is not None and not self._stopping:
             try:
                 await self.gcs.call_async(
@@ -890,6 +925,12 @@ class Raylet:
             release(kill_worker=False)
             return {"ok": False, "fatal": True,
                     "error": reply.get("error", "creation failed")}
+        # retain the spec so a restarted GCS can rebuild its actor table
+        # from this node's live actors (GCS FT)
+        self.hosted_actors[spec["actor_id"]] = {
+            "spec": spec,
+            "address": [w.worker_id, w.addr, self.node_id],
+        }
         return {"ok": True, "address": [w.worker_id, w.addr, self.node_id]}
 
     async def rpc_kill_worker(self, conn, data):

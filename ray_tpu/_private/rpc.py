@@ -314,26 +314,58 @@ class Server:
 
 
 class Client:
-    """Sync facade over a Connection for non-IO threads."""
+    """Sync facade over a Connection for non-IO threads. Remembers its
+    address so `call` can transparently reconnect after the server restarts
+    (GCS fault tolerance: the file-backed GCS comes back at the same
+    address)."""
 
-    def __init__(self, conn: Connection, io: EventLoopThread):
+    def __init__(self, conn: Connection, io: EventLoopThread,
+                 addr: str = "", handler=None, name: str = ""):
         self.conn = conn
         self.io = io
+        self._addr = addr
+        self._handler = handler
+        self._name = name
+        self._reconnect_lock = threading.Lock()
+        self._closed_by_user = False
+        # called with this Client after a successful reconnect (e.g. to
+        # replay pubsub subscriptions the restarted server lost)
+        self.on_reconnect = None
 
     @classmethod
     def connect(cls, addr: str, handler=None, timeout=30.0, name="") -> "Client":
         if ":" not in addr or addr.startswith("/"):
             addr = "unix:" + addr  # back-compat: bare socket path
         io = EventLoopThread.get()
-        return cls(io.run(connect_async(addr, handler, timeout, name)), io)
+        return cls(
+            io.run(connect_async(addr, handler, timeout, name)),
+            io, addr=addr, handler=handler, name=name,
+        )
+
+    def _maybe_reconnect(self):
+        if not self.conn.closed or not self._addr or self._closed_by_user:
+            return
+        with self._reconnect_lock:  # one reconnect wins; no orphan conns
+            if self.conn.closed and not self._closed_by_user:
+                self.conn = self.io.run(
+                    connect_async(self._addr, self._handler, 10.0, self._name)
+                )
+                if self.on_reconnect is not None:
+                    try:
+                        self.on_reconnect(self)
+                    except Exception:
+                        pass
 
     def call(self, method: str, data: Any = None, timeout=None) -> Any:
+        self._maybe_reconnect()
         return self.io.run(self.conn.call_async(method, data, timeout=timeout))
 
     def notify(self, method: str, data: Any = None):
+        self._maybe_reconnect()
         self.io.run(self.conn.notify_async(method, data))
 
     def close(self):
+        self._closed_by_user = True
         if not self.conn.closed:
             self.io.call_soon(self.conn._do_close)
 

@@ -50,6 +50,10 @@ def init(
     if global_worker.connected:
         logger.warning("ray_tpu.init() called twice; ignoring")
         return {}
+    if address is None:
+        # submitted jobs (job_submission) and CLI tools join the running
+        # cluster via RAYTPU_ADDRESS (parity: RAY_ADDRESS)
+        address = os.environ.get("RAYTPU_ADDRESS") or None
     GLOBAL_CONFIG.initialize(system_config)
     if object_store_memory:
         GLOBAL_CONFIG.load({"object_store_memory_bytes": int(object_store_memory)})

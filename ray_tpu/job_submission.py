@@ -1,0 +1,216 @@
+"""Job submission: run driver scripts on the cluster with tracked status.
+
+Parity: reference ``dashboard/modules/job/`` — ``JobSubmissionClient``
+(python/ray/job_submission), ``JobManager``/``JobSupervisor`` actor
+(job_manager.py:516,140). The supervisor is a named actor hosting the
+entrypoint as a subprocess; it survives the submitting client's exit
+(our GCS-placed actors are not tied to the creator's connection), captures
+logs to the session dir, and records status in the GCS KV under
+``jobsub:<id>``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+# terminal + live statuses (parity: JobStatus enum)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+class _JobSupervisor:
+    """Actor body: runs the entrypoint subprocess and tracks it."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]]):
+        import subprocess
+        import threading
+
+        from ray_tpu._private.worker import global_worker
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        cw = global_worker.core_worker
+        self._gcs = cw.gcs
+        log_dir = os.path.join(cw.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, f"job-{job_id}.log")
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        # the job's driver joins THIS cluster via the GCS address
+        env["RAYTPU_ADDRESS"] = cw.gcs_addr
+        out = open(self.log_path, "wb")
+        self._set_status(RUNNING, pid=None)
+        try:
+            self._proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=out, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True,
+            )
+        except Exception as e:
+            out.close()
+            self._set_status(FAILED, message=str(e))
+            raise
+        out.close()
+        self._set_status(RUNNING, pid=self._proc.pid)
+
+        self._stop_requested = threading.Event()
+
+        def watch():
+            rc = self._proc.wait()
+            # stop() sets the flag BEFORE killing, so signal-death after a
+            # stop request is STOPPED, never FAILED (no status race)
+            if self._stop_requested.is_set():
+                self._set_status(STOPPED)
+                return
+            self._set_status(
+                SUCCEEDED if rc == 0 else FAILED,
+                message=f"exit code {rc}" if rc else "",
+            )
+
+        threading.Thread(target=watch, daemon=True).start()
+
+    # -- status records in the GCS KV --
+
+    def _get_status(self) -> Dict:
+        blob = self._gcs.call("kv_get", f"jobsub:{self.job_id}")
+        return json.loads(bytes(blob)) if blob else {}
+
+    def _set_status(self, status: str, **extra):
+        rec = self._get_status()
+        rec.update(
+            {
+                "job_id": self.job_id,
+                "entrypoint": self.entrypoint,
+                "status": status,
+                "updated_at": time.time(),
+                "log_path": getattr(self, "log_path", ""),
+                **extra,
+            }
+        )
+        rec.setdefault("start_time", time.time())
+        self._gcs.call(
+            "kv_put", [f"jobsub:{self.job_id}", json.dumps(rec).encode(), True]
+        )
+
+    # -- actor API --
+
+    def status(self) -> Dict:
+        return self._get_status()
+
+    def tail_logs(self, offset: int = 0, max_bytes: int = 1 << 20):
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
+            return {"data": data, "next_offset": offset + len(data)}
+        except FileNotFoundError:
+            return {"data": b"", "next_offset": offset}
+
+    def stop(self) -> bool:
+        self._stop_requested.set()
+        if self._proc.poll() is None:
+            import signal
+
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            deadline = time.monotonic() + 5
+            while self._proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if self._proc.poll() is None:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+        self._set_status(STOPPED)
+        return True
+
+
+class JobSubmissionClient:
+    """Submit and manage jobs (parity: ray.job_submission
+    .JobSubmissionClient; RPC instead of the reference's REST head)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        from ray_tpu._private.worker import require_connected
+
+        self._gcs = require_connected().gcs
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        job_id = submission_id or f"raytpu_job_{os.urandom(6).hex()}"
+        env_vars = (runtime_env or {}).get("env_vars")
+        # PENDING record first: status is queryable before the supervisor
+        # actor finishes placement
+        self._gcs.call(
+            "kv_put",
+            [
+                f"jobsub:{job_id}",
+                json.dumps(
+                    {
+                        "job_id": job_id,
+                        "entrypoint": entrypoint,
+                        "status": PENDING,
+                        "start_time": time.time(),
+                    }
+                ).encode(),
+                True,
+            ],
+        )
+        sup_cls = ray_tpu.remote(
+            num_cpus=0.1, name=f"_job_supervisor_{job_id}"
+        )(_JobSupervisor)
+        sup_cls.remote(job_id, entrypoint, env_vars)
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_supervisor_{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        blob = self._gcs.call("kv_get", f"jobsub:{job_id}")
+        if blob is None:
+            raise ValueError(f"no job {job_id!r}")
+        return json.loads(bytes(blob))["status"]
+
+    def get_job_info(self, job_id: str) -> Dict:
+        blob = self._gcs.call("kv_get", f"jobsub:{job_id}")
+        if blob is None:
+            raise ValueError(f"no job {job_id!r}")
+        return json.loads(bytes(blob))
+
+    def get_job_logs(self, job_id: str) -> str:
+        out = ray_tpu.get(
+            self._supervisor(job_id).tail_logs.remote(), timeout=60
+        )
+        return bytes(out["data"]).decode(errors="replace")
+
+    def list_jobs(self) -> List[Dict]:
+        jobs = []
+        for key in self._gcs.call("kv_keys", "jobsub:"):
+            blob = self._gcs.call("kv_get", key)
+            if blob:
+                jobs.append(json.loads(bytes(blob)))
+        return sorted(jobs, key=lambda j: j.get("start_time", 0))
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(
+            self._supervisor(job_id).stop.remote(), timeout=60
+        )
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in (SUCCEEDED, FAILED, STOPPED):
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still {status} after {timeout}s")

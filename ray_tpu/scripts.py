@@ -36,6 +36,15 @@ def main(argv=None) -> int:
     sub.add_parser("summary", help="per-task-name state counts")
     tp = sub.add_parser("timeline", help="dump chrome-trace JSON")
     tp.add_argument("-o", "--output", default="timeline.json")
+    jp = sub.add_parser("job", help="submit/inspect cluster jobs")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint", help="shell command, e.g. 'python train.py'")
+    js.add_argument("--wait", action="store_true")
+    for name in ("status", "logs", "stop"):
+        jx = jsub.add_parser(name)
+        jx.add_argument("job_id")
+    jsub.add_parser("list")
     args = p.parse_args(argv)
 
     if not args.address:
@@ -63,6 +72,26 @@ def main(argv=None) -> int:
     elif args.cmd == "timeline":
         events = state.timeline(args.output)
         print(f"wrote {len(events)} events to {args.output}")
+    elif args.cmd == "job":
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient()
+        if args.job_cmd == "submit":
+            job_id = client.submit_job(entrypoint=args.entrypoint)
+            print(job_id)
+            if args.wait:
+                status = client.wait_until_finished(job_id)
+                print(status)
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_cmd == "status":
+            print(client.get_job_status(args.job_id))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+        elif args.job_cmd == "stop":
+            client.stop_job(args.job_id)
+            print("stopped")
+        elif args.job_cmd == "list":
+            print(json.dumps(client.list_jobs(), indent=2))
     return 0
 
 
