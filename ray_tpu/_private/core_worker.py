@@ -1728,7 +1728,8 @@ class CoreWorker:
                     )
                 )
             )
-        returns = [["v", packed] for _ in range(spec.num_returns)]
+        n = 1 if spec.num_returns == -1 else spec.num_returns
+        returns = [["v", packed] for _ in range(n)]
         return {"returns": returns, "error": str(e)}
 
     @staticmethod
@@ -1741,7 +1742,20 @@ class CoreWorker:
     def _encode_returns(self, spec: TaskSpec, result) -> Dict:
         if spec.num_returns == 0:
             return {"returns": []}
-        if spec.num_returns == 1:
+        if spec.num_returns == -1:
+            # dynamic generator task: each yield becomes its own object
+            # (put by this executor), the single return is the ref list.
+            # KNOWN DEVIATION from the reference: the executor worker owns
+            # the yielded objects (reference assigns the caller). The bytes
+            # live in the node's raylet-owned store, so gets keep working
+            # if this worker exits — but lineage reconstruction and
+            # owner-driven freeing stop at the worker's lifetime. Streaming
+            # generators with caller ownership are the successor design.
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            refs = [self.put(item) for item in result]
+            values = [ObjectRefGenerator(refs)]
+        elif spec.num_returns == 1:
             values = [result]
         else:
             values = list(result)

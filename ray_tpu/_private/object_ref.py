@@ -104,3 +104,26 @@ def install_ref_hooks(on_created, on_deleted):
     global _on_ref_created, _on_ref_deleted
     _on_ref_created = on_created or _noop
     _on_ref_deleted = on_deleted or _noop
+
+
+class ObjectRefGenerator:
+    """Result of a ``num_returns="dynamic"`` generator task: an iterable of
+    the ObjectRefs created from the task's yields (parity: reference
+    DynamicObjectRefGenerator / _raylet.pyx:237 streaming generators —
+    here the eager 'dynamic' variant: refs exist once the task finishes).
+    """
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"

@@ -72,9 +72,10 @@ class TaskSpec:
         return TaskID(self.task_id)
 
     def return_ids(self) -> List[ObjectID]:
-        return [
-            ObjectID.from_task(self.tid, i + 1) for i in range(self.num_returns)
-        ]
+        # num_returns == -1 ("dynamic" generator task): ONE return whose
+        # value is an ObjectRefGenerator over the yielded objects
+        n = 1 if self.num_returns == -1 else self.num_returns
+        return [ObjectID.from_task(self.tid, i + 1) for i in range(n)]
 
 
 @dataclasses.dataclass

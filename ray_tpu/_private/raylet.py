@@ -261,6 +261,23 @@ class Raylet:
         self.infeasible_queue = remaining
         self._pump_lease_queue()
 
+    def _queued_demand(self) -> Dict[str, float]:
+        """Resource totals of queued + parked lease requests — the signal
+        the autoscaler scales on (parity: reference resource_load/demand in
+        raylet heartbeats feeding autoscaler.py:166)."""
+        demand: Dict[str, float] = {}
+        for summary, fut, _conn in self.lease_queue:
+            if fut.done():
+                continue
+            for r, q in (summary.get("resources") or {}).items():
+                demand[r] = demand.get(r, 0.0) + q
+        for summary, fut, _dl, _conn in self.infeasible_queue:
+            if fut.done():
+                continue
+            for r, q in (summary.get("resources") or {}).items():
+                demand[r] = demand.get(r, 0.0) + q
+        return demand
+
     async def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.health_check_period_ms / 1e3
         while not self._stopping:
@@ -269,7 +286,9 @@ class Raylet:
                     "heartbeat",
                     [
                         self.node_id,
-                        {"available": self.available, "total": self.total_resources},
+                        {"available": self.available,
+                         "total": self.total_resources,
+                         "demand": self._queued_demand()},
                     ],
                     timeout=10,
                 )
@@ -1301,6 +1320,7 @@ class Raylet:
             "num_idle": len(self.idle),
             "num_leases": len(self.leases),
             "queue_len": len(self.lease_queue),
+            "demand": self._queued_demand(),
             "store": self.store.stats() if self.store else {},
         }
 

@@ -20,6 +20,13 @@ from ray_tpu.remote_function import (
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        if not isinstance(num_returns, int) or isinstance(
+            num_returns, bool
+        ) or num_returns < 0:
+            raise ValueError(
+                "actor methods take a non-negative int num_returns "
+                f"(got {num_returns!r}; 'dynamic' generators are task-only)"
+            )
         self._handle = handle
         self._name = name
         self._num_returns = num_returns

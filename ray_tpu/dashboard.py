@@ -1,0 +1,124 @@
+"""Dashboard: HTTP JSON API + minimal UI over the state API.
+
+Parity: reference ``dashboard/head.py`` (aiohttp API + React frontend) at
+the scale this wheel needs: a stdlib HTTP server exposing
+``/api/{status,nodes,tasks,actors,placement_groups,jobs,metrics,summary}``
+and one self-refreshing HTML page. Runs in the driver process (it needs a
+cluster connection); production deployments front it however they like.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
+ h1 { color: #7fdbca; } h2 { color: #82aaff; margin-top: 1.5em; }
+ pre { background: #1a1a1a; padding: 1em; border-radius: 6px;
+       overflow-x: auto; }
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading...</div>
+<script>
+async function refresh() {
+  const sections = ["status", "nodes", "actors", "summary",
+                    "placement_groups", "jobs"];
+  let html = "";
+  for (const s of sections) {
+    try {
+      const r = await fetch("/api/" + s);
+      html += "<h2>" + s + "</h2><pre>" +
+              JSON.stringify(await r.json(), null, 2) + "</pre>";
+    } catch (e) { html += "<h2>" + s + "</h2><pre>" + e + "</pre>"; }
+  }
+  document.getElementById("content").innerHTML = html;
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+def _api(path: str):
+    from ray_tpu.util import state
+
+    if path == "status":
+        return state.cluster_status()
+    if path == "nodes":
+        return state.list_nodes()
+    if path == "tasks":
+        return state.list_tasks()
+    if path == "summary":
+        return state.summarize_tasks()
+    if path == "actors":
+        return state.list_actors()
+    if path == "placement_groups":
+        return state.list_placement_groups()
+    if path == "jobs":
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        return JobSubmissionClient().list_jobs()
+    if path == "metrics":
+        from ray_tpu.util import metrics
+
+        agg = metrics.collect_cluster_metrics()
+        return {
+            name: {"type": m["type"],
+                   "values": {str(k): v for k, v in m["values"].items()}}
+            for name, m in agg.items()
+        }
+    raise KeyError(path)
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> str:
+    """Start the dashboard HTTP server; returns its URL."""
+    global _server
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            try:
+                if self.path in ("/", "/index.html"):
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                elif self.path.startswith("/api/"):
+                    body = json.dumps(
+                        _api(self.path[len("/api/"):].strip("/")),
+                        default=str,
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    raise KeyError(self.path)
+                self.send_response(200)
+            except KeyError:
+                body = b'{"error": "not found"}'
+                ctype = "application/json"
+                self.send_response(404)
+            except Exception as e:  # noqa: BLE001
+                body = json.dumps({"error": str(e)}).encode()
+                ctype = "application/json"
+                self.send_response(500)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    _server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True).start()
+    h, p = _server.server_address
+    return f"http://{h}:{p}"
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -50,7 +50,7 @@ class RemoteFunction:
             self._fn,
             wire,
             name=opts.get("name") or self._fn.__name__,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=_normalize_num_returns(opts.get("num_returns", 1)),
             resources=_resources_from(opts),
             max_retries=opts.get("max_retries"),
             retry_exceptions=opts.get("retry_exceptions", False),
@@ -58,9 +58,20 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             pinned=pinned,
         )
-        if opts.get("num_returns", 1) == 1:
+        if opts.get("num_returns", 1) in (1, "dynamic"):
             return refs[0]
         return refs
+
+
+def _normalize_num_returns(nr):
+    """'dynamic' -> -1 (generator task); otherwise a non-negative int."""
+    if nr == "dynamic":
+        return -1
+    if not isinstance(nr, int) or isinstance(nr, bool) or nr < 0:
+        raise ValueError(
+            f"num_returns must be a non-negative int or 'dynamic', got {nr!r}"
+        )
+    return nr
 
 
 def _normalize_opts(opts: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,192 @@
+"""Workflow: durable DAG execution with resume.
+
+Parity: reference ``python/ray/workflow/`` — ``WorkflowExecutor``
+(workflow_executor.py:32), step-result storage (workflow_storage.py),
+``workflow.run``/``resume``. Steps are ``.bind()`` DAG nodes (ray_tpu.dag);
+every step's result is persisted under the workflow's storage directory
+before its dependents run, so a crashed workflow resumes from the last
+completed step instead of recomputing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, InputNode
+
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+
+
+def _default_storage() -> str:
+    return os.path.expanduser("~/ray_tpu_workflows")
+
+
+def _step_id(node: DAGNode, child_ids: List[str], literals_repr: str) -> str:
+    """Deterministic step identity: function name + upstream structure +
+    literal args. Stable across runs => resumable."""
+    name = getattr(node._fn, "__name__", "step")
+    h = hashlib.sha256(
+        json.dumps([name, child_ids, literals_repr]).encode()
+    ).hexdigest()[:16]
+    return f"{name}_{h}"
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, storage: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(storage, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- metadata --
+
+    def _meta_path(self):
+        return os.path.join(self.dir, "workflow.json")
+
+    def save_meta(self, status: str, dag_blob: Optional[bytes] = None,
+                  input_blob: Optional[bytes] = None, error: str = ""):
+        meta = self.load_meta() or {}
+        meta.update({"workflow_id": self.workflow_id, "status": status,
+                     "updated_at": time.time(), "error": error})
+        with open(self._meta_path(), "w") as f:
+            json.dump(meta, f)
+        if dag_blob is not None:
+            with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+                f.write(dag_blob)
+        if input_blob is not None:
+            with open(os.path.join(self.dir, "input.pkl"), "wb") as f:
+                f.write(input_blob)
+
+    def load_meta(self) -> Optional[Dict]:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    # -- step results --
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.dir, f"step_{step_id}.pkl"))
+
+    def load_step(self, step_id: str):
+        with open(os.path.join(self.dir, f"step_{step_id}.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, step_id: str, value) -> None:
+        path = os.path.join(self.dir, f"step_{step_id}.pkl")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f, protocol=5)
+        os.replace(tmp, path)
+
+
+def _execute_node(node: DAGNode, input_value, run: _WorkflowRun,
+                  memo: Dict[int, Any]) -> Any:
+    """Post-order durable execution. Returns the node's VALUE."""
+    if id(node) in memo:
+        return memo[id(node)]
+
+    child_ids: List[str] = []
+    literals: List[str] = []
+    resolved_args = []
+    for a in node._args:
+        if isinstance(a, DAGNode):
+            resolved_args.append(_execute_node(a, input_value, run, memo))
+            child_ids.append(memo[f"id:{id(a)}"])
+        elif isinstance(a, InputNode):
+            resolved_args.append(input_value)
+            literals.append("<input>")
+        else:
+            resolved_args.append(a)
+            literals.append(repr(a))
+    resolved_kwargs = {}
+    for k, v in sorted(node._kwargs.items()):
+        if isinstance(v, DAGNode):
+            resolved_kwargs[k] = _execute_node(v, input_value, run, memo)
+            child_ids.append(f"{k}={memo[f'id:{id(v)}']}")
+        elif isinstance(v, InputNode):
+            resolved_kwargs[k] = input_value
+            literals.append(f"{k}=<input>")
+        else:
+            resolved_kwargs[k] = v
+            literals.append(f"{k}={v!r}")
+
+    sid = _step_id(node, child_ids, "|".join(literals))
+    memo[f"id:{id(node)}"] = sid
+    if run.has_step(sid):
+        value = run.load_step(sid)
+    else:
+        value = ray_tpu.get(
+            node._fn.remote(*resolved_args, **resolved_kwargs), timeout=600
+        )
+        run.save_step(sid, value)
+    memo[id(node)] = value
+    return value
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        workflow_input: Any = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute the DAG durably; returns the root's value. Re-running (or
+    :func:`resume`-ing) the same workflow_id skips completed steps."""
+    workflow_id = workflow_id or f"workflow_{os.urandom(6).hex()}"
+    wf = _WorkflowRun(workflow_id, storage or _default_storage())
+    import cloudpickle
+
+    wf.save_meta(RUNNING, dag_blob=cloudpickle.dumps(dag),
+                 input_blob=pickle.dumps(workflow_input))
+    try:
+        out = _execute_node(dag, workflow_input, wf, {})
+    except Exception as e:
+        wf.save_meta(FAILED, error=str(e))
+        raise
+    wf.save_meta(SUCCEEDED)
+    return out
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-drive a FAILED/interrupted workflow from its persisted DAG;
+    completed steps load from storage."""
+    wf = _WorkflowRun(workflow_id, storage or _default_storage())
+    meta = wf.load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    import cloudpickle
+
+    with open(os.path.join(wf.dir, "dag.pkl"), "rb") as f:
+        dag = cloudpickle.load(f)
+    with open(os.path.join(wf.dir, "input.pkl"), "rb") as f:
+        workflow_input = pickle.load(f)
+    try:
+        out = _execute_node(dag, workflow_input, wf, {})
+    except Exception as e:
+        wf.save_meta(FAILED, error=str(e))
+        raise
+    wf.save_meta(SUCCEEDED)
+    return out
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None) -> str:
+    meta = _WorkflowRun(workflow_id, storage or _default_storage()).load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    return meta["status"]
+
+
+def list_all(*, storage: Optional[str] = None) -> List[Dict]:
+    base = storage or _default_storage()
+    out = []
+    if os.path.isdir(base):
+        for wid in sorted(os.listdir(base)):
+            meta = _WorkflowRun(wid, base).load_meta()
+            if meta:
+                out.append(meta)
+    return out
