@@ -1201,6 +1201,7 @@ class CoreWorker:
         args_wire: List,
         *,
         num_returns: int = 1,
+        max_task_retries: int = 0,
         pinned=None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_task()
@@ -1212,6 +1213,7 @@ class CoreWorker:
             args=args_wire,
             num_returns=num_returns,
             resources={},
+            max_retries=max_task_retries,
             owner=self.address.to_wire(),
             actor_id=actor_id,
             method_name=method_name,
@@ -1300,6 +1302,12 @@ class CoreWorker:
             addr = self._actor_addr_cache.get(spec.actor_id)
             if addr is None:
                 got = await self._actor_address(spec.actor_id)
+                if got is None and spec.max_retries != 0:
+                    # still RESTARTING past the address deadline and the
+                    # user opted into retries: keep waiting (a DEAD record
+                    # — restarts exhausted — exits via the branch below)
+                    await asyncio.sleep(1.0)
+                    continue
                 if got is None or isinstance(got, dict) and got.get("state") == "DEAD":
                     cause = got.get("death_cause", "") if isinstance(got, dict) else ""
                     self._fail_task(
@@ -1348,10 +1356,18 @@ class CoreWorker:
                 await asyncio.sleep(0.2 * attempts)
                 continue
             except Exception:
-                # In-flight when the actor died: the method may have (partially)
-                # executed — fail rather than re-execute (parity: reference
-                # RayActorError semantics without max_task_retries).
+                # In-flight when the actor died: the method may have
+                # (partially) executed. Default: fail (reference
+                # RayActorError semantics). With max_task_retries > 0 the
+                # user opted into at-least-once: wait for the restarted
+                # incarnation and resubmit (reference max_task_retries).
                 self._actor_addr_cache.pop(spec.actor_id, None)
+                if spec.max_retries != 0:  # negative = infinite retries
+                    if spec.max_retries > 0:
+                        spec.max_retries -= 1
+                    attempts = 0  # new incarnation: fresh connect budget
+                    await asyncio.sleep(0.2)
+                    continue
                 self._fail_task(
                     spec,
                     exc.ActorDiedError(
@@ -1360,6 +1376,15 @@ class CoreWorker:
                     ),
                 )
                 return
+            if reply.get("system_error") and spec.max_retries != 0:
+                # e.g. "actor instance not initialized": the retried task
+                # beat the restarted actor's creation — retry, don't route
+                # into the plain-task worker-failure path
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1
+                self._actor_addr_cache.pop(spec.actor_id, None)
+                await asyncio.sleep(0.2)
+                continue
             self._handle_task_reply(spec, reply, addr)
             return
 

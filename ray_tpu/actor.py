@@ -54,6 +54,9 @@ class ActorMethod:
             self._name,
             wire,
             num_returns=self._num_returns,
+            max_task_retries=self._handle._method_meta.get(
+                "__max_task_retries__", 0
+            ),
             pinned=pinned,
         )
         if self._num_returns == 1:
@@ -125,6 +128,10 @@ class ActorClass:
         wire, pinned = cw._encode_args(values)
         opts = self._opts
         meta = _method_meta_of(self._cls)
+        if opts.get("max_task_retries"):
+            # carried in method_meta so every handle (incl. get_actor /
+            # deserialized ones) applies it to method submissions
+            meta["__max_task_retries__"] = int(opts["max_task_retries"])
         actor_id = cw.create_actor(
             self._cls,
             wire,

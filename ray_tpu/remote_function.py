@@ -40,6 +40,11 @@ class RemoteFunction:
         return DAGNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
+        if "max_task_retries" in self._opts:
+            raise ValueError(
+                "max_task_retries is an actor option; plain tasks use "
+                "max_retries"
+            )
         cw = require_connected()
         values = list(args)
         if kwargs:
@@ -79,6 +84,7 @@ def _normalize_opts(opts: Dict[str, Any]) -> Dict[str, Any]:
         "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
         "retry_exceptions", "name", "scheduling_strategy", "max_restarts",
         "max_concurrency", "runtime_env", "num_gpus", "memory", "lifetime",
+        "max_task_retries",
     }
     for k in opts:
         if k not in known:

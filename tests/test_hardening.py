@@ -1,0 +1,173 @@
+"""Hardening tests from the round-1 verdict's weak list.
+
+Weak #9: chained (multi-hop) lineage reconstruction.
+Weak #10: the honest retry scenario — a retried task whose resources
+vanished parks until they reappear, instead of being dodged.
+Plus: actor max_task_retries across restarts, and FSDP sharding rules
+actually exercised (VERDICT component #47).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster2():
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+    )
+    c.worker_node = c.add_node(num_cpus=2, resources={"other": 1})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_chained_lineage_reconstruction(cluster2):
+    """Losing BOTH an object and its input reconstructs the whole chain:
+    get(y) resubmits g, whose lost arg x resubmits f (reference
+    ObjectRecoveryManager recursion, object_recovery_manager.h:41)."""
+
+    @ray_tpu.remote(max_retries=4, resources={"other": 0.1})
+    def f():
+        return np.full(1 << 18, 3, dtype=np.int64)  # plasma-sized
+
+    @ray_tpu.remote(max_retries=4, resources={"other": 0.1})
+    def g(x):
+        return x * 2
+
+    x = f.remote()
+    y = g.remote(x)
+    assert int(ray_tpu.get(y, timeout=60)[0]) == 6  # materialize both
+    # kill the node holding BOTH objects
+    cluster2.remove_node(cluster2.worker_node)
+    cluster2.add_node(num_cpus=2, resources={"other": 1})
+    out = ray_tpu.get(y, timeout=120)
+    assert int(out[0]) == 6 and out.shape == (1 << 18,)
+    # and x itself is independently recoverable too
+    assert int(ray_tpu.get(x, timeout=120)[0]) == 3
+
+
+def test_retry_waits_for_resources_to_reappear(cluster2):
+    """The round-1 test dodged this: a retried task requiring a resource
+    that died with its node must PARK (still pending), then complete once
+    a node with that resource joins."""
+
+    @ray_tpu.remote(max_retries=3, resources={"other": 1})
+    def slow_on_other():
+        time.sleep(3)
+        return "done"
+
+    ref = slow_on_other.remote()
+    time.sleep(1.0)  # ensure it is running on the 'other' node
+    cluster2.remove_node(cluster2.worker_node)
+    # the retry is infeasible right now: the get must still be PENDING
+    ready, pending = ray_tpu.wait([ref], timeout=3)
+    assert not ready, "task completed without its required resource?"
+    # resource reappears -> the parked retry is released and completes
+    cluster2.add_node(num_cpus=2, resources={"other": 1})
+    assert ray_tpu.get(ref, timeout=120) == "done"
+
+
+def test_actor_max_task_retries_across_restart(rt=None):
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+        class Flaky:
+            def __init__(self, marker):
+                self.marker = marker
+
+            def work(self):
+                import os
+
+                if not os.path.exists(self.marker):
+                    open(self.marker, "w").close()
+                    os._exit(1)  # die mid-method, first attempt only
+                return "recovered"
+
+        import tempfile
+
+        marker = tempfile.mktemp()
+        a = Flaky.remote(marker)
+        # first attempt kills the actor; GCS restarts it; the method retries
+        assert ray_tpu.get(a.work.remote(), timeout=120) == "recovered"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_without_task_retries_fails_on_death():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_restarts=1)
+        class Dies:
+            def boom(self):
+                import os
+
+                os._exit(1)
+
+        a = Dies.remote()
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+            ray_tpu.get(a.boom.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fsdp_rules_shard_params_over_dp():
+    """FSDP_RULES (embed -> dp): parameters/optimizer state genuinely
+    ZeRO-sharded over the data axis; loss matches the replicated setup."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mesh import FSDP_RULES, MeshConfig, build_mesh
+    from ray_tpu.parallel.train_step import (
+        batch_sharding,
+        default_optimizer,
+        make_sharded_state,
+        make_train_step,
+    )
+
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(max_seq_len=32), dtype=jnp.float32
+    )
+    mesh = build_mesh(MeshConfig(dp=8))
+    opt = default_optimizer(lr=1e-2)
+
+    fsdp_state, fsdp_sh = make_sharded_state(
+        cfg, mesh, opt, jax.random.key(0), rules=FSDP_RULES
+    )
+    def has_dp(spec):
+        return any(ax == "dp" or ax == ("dp",) for ax in (spec or ()))
+
+    # embed's embedding dim is sharded over dp (ZeRO-3 style param sharding)
+    assert has_dp(fsdp_state.params["embed"].sharding.spec)
+    # adam mu mirrors the param sharding (optimizer state sharded too)
+    mu = jax.tree.leaves(
+        jax.tree.map(lambda x: x.sharding, fsdp_state.opt_state)
+    )
+    assert any(has_dp(s.spec) for s in mu)
+
+    step_fsdp = make_train_step(cfg, mesh, opt, fsdp_sh, rules=FSDP_RULES)
+    base_state, base_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
+    step_base = make_train_step(cfg, mesh, opt, base_sh)
+
+    tokens = jnp.ones((8, 32), jnp.int32)
+    def batch(rules_sh):
+        return {
+            "tokens": jax.device_put(tokens, rules_sh),
+            "targets": jax.device_put(tokens, rules_sh),
+            "mask": jax.device_put(jnp.ones((8, 32), jnp.float32), rules_sh),
+        }
+
+    _, m_fsdp = step_fsdp(fsdp_state, batch(batch_sharding(mesh, FSDP_RULES)))
+    _, m_base = step_base(base_state, batch(batch_sharding(mesh)))
+    np.testing.assert_allclose(
+        float(m_fsdp["loss"]), float(m_base["loss"]), rtol=2e-4
+    )
