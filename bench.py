@@ -86,6 +86,40 @@ def main():
         )
         return dt, flops / dt / peak, tokens_per_step / dt
 
+    def measure_inference(cfg, batch, prompt_len, new_tokens):
+        """Serving shape (BASELINE: batched inference TTFT): prefill latency
+        + steady-state decode throughput via the KV cache."""
+        from ray_tpu.models.generation import decode_loop, prefill
+        from ray_tpu.models.transformer import init_params
+
+        params = jax.jit(
+            lambda k: init_params(cfg, k),
+        )(jax.random.key(0))
+        prompt = jax.random.randint(
+            jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+        ).astype(jnp.int32)
+        max_len = prompt_len + new_tokens + 1
+        logits, cache = prefill(params, prompt, cfg, max_len)  # compile
+        jax.block_until_ready(logits)
+        t0 = time.perf_counter()
+        logits, cache = prefill(params, prompt, cfg, max_len)
+        jax.block_until_ready(logits)
+        ttft_ms = (time.perf_counter() - t0) * 1e3
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        args = (params, first, cache, jnp.array(prompt_len, jnp.int32),
+                cfg, new_tokens, 0.0, jax.random.key(2))
+        jax.block_until_ready(decode_loop(*args))  # compile
+        t0 = time.perf_counter()
+        out = decode_loop(*args)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        return {
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "ttft_ms": round(ttft_ms, 2),
+            "decode_tokens_per_s": round(batch * new_tokens / dt, 1),
+        }
+
     if on_accel:
         cfg = TransformerConfig.bench_400m()
         dt, mfu, tps = measure(cfg, batch=8, seq=2048, iters=10)
@@ -98,11 +132,19 @@ def main():
             "step_ms": round(lc_dt * 1e3, 2),
             "tokens_per_s": round(lc_tps, 1),
         }
+        try:
+            inference = measure_inference(
+                dataclasses.replace(cfg, attn_impl="dense", remat=False),
+                batch=8, prompt_len=1024, new_tokens=64,
+            )
+        except Exception as e:
+            inference = {"error": str(e)[:160]}
         metric = "train_step_mfu_400m"
     else:
         cfg = TransformerConfig.tiny()
         dt, mfu, tps = measure(cfg, batch=4, seq=128, iters=3)
         long_ctx = None
+        inference = None
         metric = "train_step_mfu_tiny_cpu"
 
     # Core-runtime microbenchmarks (reference ray_perf.py — the canonical
@@ -133,6 +175,7 @@ def main():
             "tokens_per_s": round(tps, 1),
             "attn_impl": cfg.attn_impl,
             "long_ctx": long_ctx,
+            "inference": inference,
             "micro": micro,
         },
     }

@@ -1,0 +1,174 @@
+"""Autoregressive generation with a KV cache (prefill + decode).
+
+The inference half of the flagship model (the BASELINE's Serve target is
+batched LLM inference TTFT): ``prefill`` runs the prompt through the stack
+once while writing K/V into a static-shape cache, ``decode_step`` extends
+by one token attending over the cache, and ``generate`` jits the whole
+prefill + ``lax.scan`` decode loop into two XLA programs (one per phase) —
+static shapes, no per-token Python. Batched greedy or temperature sampling.
+
+TPU notes: cache layout [L, B, S_max, H_kv, D] keeps the per-layer slices
+contiguous for the scanned stack; decode attends q[B,1,H,D] against the
+full cache with a position mask (masked lanes are free — the MXU work is
+the [1 x S_max] band); GQA caches only kv_heads.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _rms_norm,
+    apply_layer,
+)
+from ray_tpu.ops.attention import NEG_INF, repeat_kv
+
+
+def init_kv_cache(config: TransformerConfig, batch: int,
+                  max_len: int) -> Dict[str, jax.Array]:
+    c = config
+    shape = (c.n_layers, batch, max_len, c.kv_heads, c.d_head)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+
+def _attend_cached(q, cache_k, cache_v, q_pos, kv_len_mask):
+    """q [B,S,H,D] against cache_k/v [B,S_max,Hkv,D]; kv_len_mask [S_max]
+    marks valid cache slots; q_pos [S] are the query positions."""
+    n_rep = q.shape[2] // cache_k.shape[2]
+    k = repeat_kv(cache_k, n_rep)
+    v = repeat_kv(cache_v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = jnp.arange(k.shape[1])
+    causal = q_pos[:, None] >= k_pos[None, :]
+    mask = causal & kv_len_mask[None, :]
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _forward_cached(params, tokens, cache, start_pos, config):
+    """Run `tokens` [B, S] starting at absolute position start_pos, writing
+    K/V into the cache. Returns (logits [B, S, V], cache). The layer body
+    is the SAME ``apply_layer`` the training paths use — only the attention
+    callable differs (cache-writing, cache-attending)."""
+    c = config
+    x = params["embed"].astype(c.dtype)[tokens]
+    S = tokens.shape[1]
+    positions = start_pos + jnp.arange(S)
+    s_max = cache["k"].shape[2]
+    kv_valid = jnp.arange(s_max) < (start_pos + S)
+
+    def layer(carry, layer_in):
+        x = carry
+        lp, cache_k, cache_v = layer_in
+
+        def cached_attn(q, k, v):
+            ck = lax.dynamic_update_slice(
+                cache_k, k.astype(cache_k.dtype), (0, start_pos, 0, 0)
+            )
+            cv = lax.dynamic_update_slice(
+                cache_v, v.astype(cache_v.dtype), (0, start_pos, 0, 0)
+            )
+            return _attend_cached(q, ck, cv, positions, kv_valid), (ck, cv)
+
+        y, _aux, (ck, cv) = apply_layer(x, lp, c, positions, cached_attn)
+        return y, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["final_ln"]["scale"])
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(c.dtype))
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("config", "max_len"))
+def prefill(params, tokens, config: TransformerConfig, max_len: int):
+    """Prompt pass. Returns (last-token logits [B, V], cache)."""
+    cache = init_kv_cache(config, tokens.shape[0], max_len)
+    logits, cache = _forward_cached(params, tokens, cache, 0, config)
+    return logits[:, -1, :], cache
+
+
+@partial(jax.jit, static_argnames=("config",))
+def decode_step(params, token, cache, pos, config: TransformerConfig):
+    """One token [B] at absolute position pos. Returns (logits [B,V], cache)."""
+    logits, cache = _forward_cached(
+        params, token[:, None], cache, pos, config
+    )
+    return logits[:, 0, :], cache
+
+
+def _sample(logits, rng, temperature: float):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits.astype(jnp.float32) / temperature
+    ).astype(jnp.int32)
+
+
+@partial(jax.jit,
+         static_argnames=("config", "max_new_tokens", "temperature"))
+def decode_loop(params, first_token, cache, start_pos,
+                config, max_new_tokens, temperature, rng):
+    """Public N-step decode program (one compiled scan): feeds each sampled
+    token back in; returns [B, max_new_tokens]. Benchmarks time this for
+    steady-state decode throughput."""
+    def step(carry, _):
+        tok, cache, pos, rng = carry
+        logits, cache = _forward_cached(
+            params, tok[:, None], cache, pos, config
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, 0, :], sub, temperature)
+        return (nxt, cache, pos + 1, rng), nxt
+
+    (_, cache, _, _), toks = lax.scan(
+        step, (first_token, cache, start_pos, rng), None,
+        length=max_new_tokens,
+    )
+    return toks.T  # [B, max_new_tokens]
+
+
+def generate(
+    params,
+    prompt: jax.Array,  # [B, S] int32
+    config: TransformerConfig,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Returns [B, max_new_tokens] generated ids (greedy when
+    temperature=0). Two compiled programs: prefill and the decode scan."""
+    B, S = prompt.shape
+    max_len = max_len or config.max_seq_len
+    if S + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt {S} + new {max_new_tokens} exceeds max_len {max_len}"
+        )
+    rng = rng if rng is not None else jax.random.key(0)
+    rng, first_key = jax.random.split(rng)  # never reuse a consumed key
+    logits, cache = prefill(params, prompt, config, max_len)
+    first = _sample(logits, first_key, temperature)
+    if max_new_tokens == 1:
+        return first[:, None]
+    rest = decode_loop(
+        params, first, cache, jnp.array(S, jnp.int32), config,
+        max_new_tokens - 1, temperature, rng,
+    )
+    return jnp.concatenate([first[:, None], rest], axis=1)

@@ -267,16 +267,23 @@ def apply_layer(
 ):
     """GPT-J parallel block: y = x + attn(ln(x)) + ffn(ln(x)).
 
-    Shared by the scanned single-program forward below and the pipeline
-    schedule (parallel/pipeline.py). Returns (y, aux_loss)."""
+    Shared by the scanned single-program forward below, the pipeline
+    schedule (parallel/pipeline.py), and the KV-cached generation path
+    (models/generation.py). ``attn_fn(q, k, v)`` may return either the
+    attention output or ``(output, extra)`` — ``extra`` (e.g. updated KV
+    caches) is passed through. Returns (y, aux_loss, extra)."""
     c = config
     h = _rms_norm(x, lp["ln1"]["scale"])
     q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].astype(c.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].astype(c.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].astype(c.dtype))
     q, k = _rotary(q, k, c.rotary_dim, positions)
-    a = attn_fn(q, k, v)
-    a = jnp.einsum("bshk,hkd->bsd", a, lp["attn"]["wo"].astype(c.dtype))
+    attn_out = attn_fn(q, k, v)
+    extra = None
+    if isinstance(attn_out, tuple):
+        attn_out, extra = attn_out
+    a = jnp.einsum("bshk,hkd->bsd", attn_out,
+                   lp["attn"]["wo"].astype(c.dtype))
     if c.moe_experts:
         from ray_tpu.ops.moe import moe_ffn
 
@@ -294,7 +301,7 @@ def apply_layer(
         m = jax.nn.gelu(m)
         m = jnp.einsum("bsf,fd->bsd", m, lp["mlp"]["wo"].astype(c.dtype))
         aux = jnp.zeros((), jnp.float32)
-    return x + a + m, aux
+    return x + a + m, aux, extra
 
 
 def remat_wrap(layer_fn, config: TransformerConfig):
@@ -324,7 +331,7 @@ def forward(
 
     def layer(carry, lp):
         x, aux = carry
-        y, a = apply_layer(x, lp, c, positions, attn_fn, mesh=mesh)
+        y, a, _ = apply_layer(x, lp, c, positions, attn_fn, mesh=mesh)
         return (y, aux + a), None
 
     layer = remat_wrap(layer, c)

@@ -96,7 +96,7 @@ def pipeline_loss_fn(
 
         def stage_layers(x):
             def lyr(carry, lp):
-                y, a = apply_layer(
+                y, a, _ = apply_layer(
                     carry, lp, c, positions, causal_attention, mesh=None
                 )
                 return y, a

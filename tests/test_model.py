@@ -228,3 +228,45 @@ def test_flash_transformer_forward_matches_dense():
         np.asarray(ld, np.float32), np.asarray(lf, np.float32),
         atol=5e-2, rtol=1e-2,
     )
+
+
+def test_kv_cache_generation_matches_full_forward():
+    """Greedy decode through the KV cache must match recomputing the full
+    forward pass every step (exact: same arithmetic, fp32)."""
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(max_seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+
+    from ray_tpu.models.generation import generate
+
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+
+    toks = prompt
+    ref = []
+    for _ in range(6):
+        logits = forward(params, toks, cfg)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        ref.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.stack(ref, axis=1))
+    )
+
+
+def test_generation_sampling_and_bounds():
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(max_seq_len=32), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.ones((1, 4), jnp.int32)
+
+    from ray_tpu.models.generation import generate
+
+    out = generate(params, prompt, cfg, max_new_tokens=5, temperature=1.0,
+                   rng=jax.random.key(7))
+    assert out.shape == (1, 5)
+    assert ((out >= 0) & (out < cfg.vocab_size)).all()
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        generate(params, prompt, cfg, max_new_tokens=64)
