@@ -41,6 +41,15 @@ from ray_tpu.ops.attention import NEG_INF, causal_attention, repeat_kv
 _LANES = 128
 
 
+def _fit_block(requested: int, s: int) -> int:
+    """Largest block <= requested that divides s (halving search; a block
+    equal to s itself is always legal for Pallas)."""
+    b = min(requested, s)
+    while b > 128 and s % b:
+        b //= 2
+    return b if s % b == 0 else s
+
+
 def _block_scores(q, k, qi, kj, *, scale, block_q, block_kv, causal):
     """Masked fp32 score block s = scale * q @ k^T for tile (qi, kj)."""
     s = jax.lax.dot_general(
@@ -316,8 +325,10 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 512,
-    block_kv: int = 512,
+    # 1024x1024 tiles measured fastest on v5e for the 400M train step
+    # (+3.7 MFU points over 512x512); VMEM still fits f32 scratch + blocks.
+    block_q: int = 1024,
+    block_kv: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention on one device (or one shard under shard_map).
@@ -328,8 +339,11 @@ def flash_attention(
     b, s, h, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    block_q = min(block_q, s)
-    block_kv = min(block_kv, s)
+    # Snap blocks to divisors of the sequence: a seq divisible by 512 but
+    # not 1024 must still use the kernel (with 512 tiles), not the dense
+    # O(S^2) fallback.
+    block_q = _fit_block(block_q, s)
+    block_kv = _fit_block(block_kv, s)
     if (not _HAVE_PALLAS_TPU) or s % block_q or s % block_kv:
         return causal_attention(q, k, v, causal=causal)
     n_rep = h // k.shape[2]
