@@ -89,12 +89,17 @@ def main():
     def measure_inference(cfg, batch, prompt_len, new_tokens):
         """Serving shape (BASELINE: batched inference TTFT): prefill latency
         + steady-state decode throughput via the KV cache."""
-        from ray_tpu.models.generation import decode_loop, prefill
+        from ray_tpu.models.generation import (
+            decode_loop,
+            prefill,
+            prepare_for_inference,
+        )
         from ray_tpu.models.transformer import init_params
 
         params = jax.jit(
             lambda k: init_params(cfg, k),
         )(jax.random.key(0))
+        params, cfg = prepare_for_inference(params, cfg)
         prompt = jax.random.randint(
             jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
         ).astype(jnp.int32)

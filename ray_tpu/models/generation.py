@@ -30,6 +30,16 @@ from ray_tpu.models.transformer import (
 from ray_tpu.ops.attention import NEG_INF, repeat_kv
 
 
+def prepare_for_inference(params, config: TransformerConfig):
+    """Cast training params (fp32 master copy) to the compute dtype ONCE.
+    Serving streams every weight per decode step — fp32 params double that
+    HBM traffic just to be cast in-kernel. Returns (params, config)."""
+    import dataclasses
+
+    cast = jax.tree.map(lambda x: x.astype(config.dtype), params)
+    return cast, dataclasses.replace(config, param_dtype=config.dtype)
+
+
 def init_kv_cache(config: TransformerConfig, batch: int,
                   max_len: int) -> Dict[str, jax.Array]:
     c = config
@@ -70,24 +80,36 @@ def _forward_cached(params, tokens, cache, start_pos, config):
     s_max = cache["k"].shape[2]
     kv_valid = jnp.arange(s_max) < (start_pos + S)
 
+    # The FULL cache travels as the scan CARRY (aliased in place by XLA)
+    # and each layer writes only its one [S]-token slice. Stacking per-layer
+    # caches as scan outputs instead would rewrite the entire cache every
+    # decode step — measured ~2x slower at 1k context, worse at 4k.
     def layer(carry, layer_in):
-        x = carry
-        lp, cache_k, cache_v = layer_in
+        x, ck_all, cv_all = carry
+        lp, li = layer_in
 
         def cached_attn(q, k, v):
-            ck = lax.dynamic_update_slice(
-                cache_k, k.astype(cache_k.dtype), (0, start_pos, 0, 0)
+            ck2 = lax.dynamic_update_slice(
+                ck_all, k[None].astype(ck_all.dtype),
+                (li, 0, start_pos, 0, 0),
             )
-            cv = lax.dynamic_update_slice(
-                cache_v, v.astype(cache_v.dtype), (0, start_pos, 0, 0)
+            cv2 = lax.dynamic_update_slice(
+                cv_all, v[None].astype(cv_all.dtype),
+                (li, 0, start_pos, 0, 0),
             )
-            return _attend_cached(q, ck, cv, positions, kv_valid), (ck, cv)
+            ck = lax.dynamic_index_in_dim(ck2, li, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cv2, li, 0, keepdims=False)
+            return _attend_cached(q, ck, cv, positions, kv_valid), (ck2, cv2)
 
-        y, _aux, (ck, cv) = apply_layer(x, lp, c, positions, cached_attn)
-        return y, (ck, cv)
+        y, _aux, (ck_all, cv_all) = apply_layer(
+            x, lp, c, positions, cached_attn
+        )
+        return (y, ck_all, cv_all), None
 
-    x, (new_k, new_v) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+    (x, new_k, new_v), _ = lax.scan(
+        layer,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(c.n_layers)),
     )
     x = _rms_norm(x, params["final_ln"]["scale"])
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
