@@ -73,6 +73,44 @@ def _api(path: str):
     raise KeyError(path)
 
 
+def _prometheus_text() -> str:
+    """Cluster metrics in Prometheus exposition format (parity: the
+    reference agent's scrape endpoint, reporter_agent.py:266)."""
+    from ray_tpu.util import metrics
+
+    def esc(v) -> str:  # Prometheus label-value escaping
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def fmt_tags(tkey, extra=()):
+        items = [f'{k}="{esc(v)}"' for k, v in tkey] + list(extra)
+        return "{" + ",".join(items) + "}" if items else ""
+
+    lines = []
+    for name, m in sorted(metrics.collect_cluster_metrics().items()):
+        mtype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}[m["type"]]
+        lines.append(f"# TYPE {name} {mtype}")
+        # bucket bounds travel with the aggregated snapshot (the histogram
+        # may have been created in another process)
+        bounds = m.get("boundaries") or []
+        for tkey, val in sorted(m["values"].items()):
+            if m["type"] in ("counter", "gauge"):
+                lines.append(f"{name}{fmt_tags(tkey)} {val}")
+            else:
+                cum = 0  # buckets are cumulative in Prometheus
+                for i, count in enumerate(val["counts"]):
+                    cum += count
+                    le = esc(bounds[i]) if i < len(bounds) else "+Inf"
+                    lines.append(
+                        f"{name}_bucket{fmt_tags(tkey, [f'le=\"{le}\"'])} "
+                        f"{cum}"
+                    )
+                lines.append(f"{name}_sum{fmt_tags(tkey)} {val['sum']}")
+                lines.append(f"{name}_count{fmt_tags(tkey)} {cum}")
+    return "\n".join(lines) + "\n"
+
+
 _server: Optional[ThreadingHTTPServer] = None
 
 
@@ -89,6 +127,9 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> str:
                 if self.path in ("/", "/index.html"):
                     body = _PAGE.encode()
                     ctype = "text/html"
+                elif self.path == "/metrics":
+                    body = _prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/api/"):
                     body = json.dumps(
                         _api(self.path[len("/api/"):].strip("/")),

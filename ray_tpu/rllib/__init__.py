@@ -10,6 +10,7 @@ Parity: reference ``rllib/algorithms/ppo/``; sampling plane =
 actor-critic update (ppo.py).
 """
 
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig"]

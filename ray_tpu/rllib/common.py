@@ -1,0 +1,46 @@
+"""Shared plumbing for the RL algorithms (PPO, IMPALA)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+def probe_env_spec(env_name: str) -> Tuple[int, int]:
+    """(obs_dim, num_actions) for a discrete-action gymnasium env."""
+    import gymnasium
+
+    probe = gymnasium.make(env_name)
+    try:
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError(
+                f"{env_name}: only discrete action spaces are supported"
+            )
+        return (
+            int(np.prod(probe.observation_space.shape)),
+            int(probe.action_space.n),
+        )
+    finally:
+        probe.close()
+
+
+def make_rollout_workers(env: str, num_workers: int, rollout_len: int,
+                         gamma: float, lam: float, seed: int) -> List:
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    cls = ray_tpu.remote(num_cpus=1)(RolloutWorker)
+    return [
+        cls.remote(env, rollout_len, gamma, lam, seed=seed + 1000 * (i + 1))
+        for i in range(num_workers)
+    ]
+
+
+def stop_workers(workers: List) -> None:
+    for w in workers:
+        try:
+            ray_tpu.kill(w)
+        except Exception:
+            pass

@@ -1,0 +1,194 @@
+"""IMPALA: asynchronous sample pipelining with V-trace off-policy correction.
+
+Parity: reference ``rllib/algorithms/impala/impala.py:68`` (async sample
+broker :685) — env-runner actors collect with (possibly stale) behavior
+policies and never barrier with each other: the driver consumes whichever
+rollout finishes first, updates the learner, ships fresh weights to THAT
+worker only, and resubmits it. The importance-weight mismatch is corrected
+by V-trace (Espeholt et al.; PAPERS.md), computed as a reverse lax.scan
+inside the single jitted update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
+
+
+def vtrace(
+    behavior_logp,  # [T]
+    target_logp,  # [T]
+    rewards,  # [T]
+    values,  # [T]  V(x_t)
+    next_values,  # [T]  V(x_{t+1}) WITHIN-episode (truncations carry the
+    #                    pre-reset state's value; rollout_worker computes it)
+    terminals,  # [T] 1.0 where the episode truly ENDED (no bootstrap)
+    cuts,  # [T] 1.0 at any episode boundary (terminal OR truncation)
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+):
+    """Returns (vs [T], pg_advantages [T]). Truncated episodes bootstrap
+    with their real next-state value (the recursion still cuts there);
+    true terminals bootstrap with zero."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rho = jnp.minimum(rho_bar, jnp.exp(target_logp - behavior_logp))
+    c = jnp.minimum(c_bar, jnp.exp(target_logp - behavior_logp))
+    boot = next_values * (1.0 - terminals)
+    deltas = rho * (rewards + gamma * boot - values)
+    cont = 1.0 - cuts  # the backward recursion never crosses a boundary
+
+    def backward(acc, inp):
+        delta_t, c_t, cont_t = inp
+        acc = delta_t + gamma * c_t * cont_t * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        backward, jnp.zeros(()), (deltas, c, cont), reverse=True
+    )
+    vs = values + vs_minus_v
+    # vs_{t+1}: the next step's corrected value inside an episode; the
+    # bootstrap value at boundaries (zero if terminal)
+    vs_next = jnp.concatenate([vs[1:], boot[-1:]])
+    vs_next = jnp.where(cuts > 0, boot, vs_next)
+    pg_adv = rho * (rewards + gamma * vs_next - values)
+    return vs, pg_adv
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    num_workers: int = 2
+    rollout_len: int = 256
+    gamma: float = 0.99
+    lr: float = 6e-4
+    entropy_coef: float = 0.01
+    vf_coef: float = 0.5
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    """``algo.train()`` = consume a few asynchronously completed rollouts,
+    one V-trace SGD step per rollout, per-worker weight refresh."""
+
+    def __init__(self, config: IMPALAConfig):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.common import make_rollout_workers, probe_env_spec
+
+        self.config = config
+        obs_dim, num_actions = probe_env_spec(config.env)
+        self.params = init_actor_critic(
+            jax.random.key(config.seed), obs_dim, num_actions, config.hidden
+        )
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._make_update())
+        self.workers = make_rollout_workers(
+            config.env, config.num_workers, config.rollout_len,
+            config.gamma, 1.0, config.seed,
+        )
+        # async pipeline state: one in-flight rollout per worker
+        self._inflight: Dict[Any, int] = {}
+        params_ref = ray_tpu.put(jax.device_get(self.params))
+        for i, w in enumerate(self.workers):
+            self._inflight[w.sample.remote(params_ref)] = i
+        self._iter = 0
+        self.num_async_updates = 0
+        self._recent: List[float] = []
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config
+
+        def loss_fn(params, batch):
+            logits, values = apply_actor_critic(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            vs, pg_adv = jax.lax.stop_gradient(
+                vtrace(
+                    batch["logp"], target_logp, batch["rewards"],
+                    jax.lax.stop_gradient(values), batch["next_values"],
+                    batch["terminals"], batch["cuts"],
+                    c.gamma, c.rho_bar, c.c_bar,
+                )
+            )
+            pg = -(target_logp * pg_adv).mean()
+            vf = ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            return pg + c.vf_coef * vf - c.entropy_coef * entropy
+
+        def update(params, opt_state, batch):
+            grads = jax.grad(loss_fn)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: process num_workers asynchronously completed
+        rollouts (whichever finish first — no barrier)."""
+        import jax
+
+        self._iter += 1
+        for _ in range(self.config.num_workers):
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=300
+            )
+            if not ready:
+                raise TimeoutError("no rollout completed within 300s")
+            ref = ready[0]
+            widx = self._inflight.pop(ref)
+            rollout = ray_tpu.get(ref)
+            self._recent.extend(rollout["episode_returns"].tolist())
+            self._recent = self._recent[-100:]
+            batch = {
+                "obs": rollout["obs"],
+                "actions": rollout["actions"],
+                "logp": rollout["logp"],
+                "rewards": rollout["rewards"],
+                "next_values": rollout["next_values"],
+                "terminals": rollout["terminals"],
+                "cuts": rollout["cuts"],
+            }
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state, batch
+            )
+            self.num_async_updates += 1
+            # refresh ONLY this worker and put it back to work (async)
+            params_ref = ray_tpu.put(jax.device_get(self.params))
+            self._inflight[
+                self.workers[widx].sample.remote(params_ref)
+            ] = widx
+        return {
+            "training_iteration": self._iter,
+            "episode_reward_mean": (
+                float(np.mean(self._recent)) if self._recent
+                else float("nan")
+            ),
+            "num_async_updates": self.num_async_updates,
+        }
+
+    def stop(self):
+        from ray_tpu.rllib.common import stop_workers
+
+        stop_workers(self.workers)

@@ -17,7 +17,6 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
-from ray_tpu.rllib.rollout_worker import RolloutWorker
 
 
 @dataclasses.dataclass
@@ -45,16 +44,13 @@ class PPO:
     one sampling+SGD iteration returning reference-shaped result metrics."""
 
     def __init__(self, config: PPOConfig):
-        import gymnasium
         import jax
         import optax
 
-        self.config = config
-        probe = gymnasium.make(config.env)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
+        from ray_tpu.rllib.common import make_rollout_workers, probe_env_spec
 
+        self.config = config
+        obs_dim, num_actions = probe_env_spec(config.env)
         self.params = init_actor_critic(
             jax.random.key(config.seed), obs_dim, num_actions, config.hidden
         )
@@ -62,15 +58,10 @@ class PPO:
         self.opt_state = self.opt.init(self.params)
         self._update = jax.jit(self._make_update())
         self._rng = jax.random.key(config.seed + 1)
-
-        worker_cls = ray_tpu.remote(num_cpus=1)(RolloutWorker)
-        self.workers = [
-            worker_cls.remote(
-                config.env, config.rollout_len, config.gamma, config.lam,
-                seed=config.seed + 1000 * (i + 1),
-            )
-            for i in range(config.num_workers)
-        ]
+        self.workers = make_rollout_workers(
+            config.env, config.num_workers, config.rollout_len,
+            config.gamma, config.lam, config.seed,
+        )
         self._iter = 0
         self._recent_returns: List[float] = []
 
@@ -178,8 +169,6 @@ class PPO:
         }
 
     def stop(self):
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        from ray_tpu.rllib.common import stop_workers
+
+        stop_workers(self.workers)

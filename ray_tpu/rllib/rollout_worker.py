@@ -113,5 +113,12 @@ class RolloutWorker:
             "logp": logp_buf,
             "advantages": adv,
             "returns": returns,
+            "rewards": rew_buf,
+            # within-episode V(x_{t+1}) — truncation steps carry the real
+            # pre-reset state's value (computed above), terminals are masked
+            # by consumers via `terminals`
+            "next_values": next_val,
+            "terminals": term_buf,  # true ends (bootstrap = 0)
+            "cuts": cut_buf,  # any boundary (terminal OR truncation)
             "episode_returns": np.asarray(completed, np.float32),
         }

@@ -164,6 +164,8 @@ def collect_cluster_metrics() -> Dict[str, Dict]:
             agg = out.setdefault(
                 name, {"type": snap["type"], "values": {}}
             )
+            if "boundaries" in snap:  # histograms: carried for renderers
+                agg.setdefault("boundaries", snap["boundaries"])
             for tags, val in snap["values"]:
                 tkey = tuple(tuple(t) for t in tags)
                 if snap["type"] in ("counter",):

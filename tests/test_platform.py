@@ -185,3 +185,25 @@ def test_dynamic_generator_returns(rt_plat):
     for i, ref in enumerate(refs):
         arr = ray_tpu.get(ref, timeout=60)
         assert int(arr[0]) == i and arr.shape == (1000,)
+
+
+def test_prometheus_metrics_endpoint(rt_plat):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("prom_requests", tag_keys=("route",))
+    c.inc(3.0, {"route": "/x"})
+    h = metrics.Histogram("prom_lat", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(20.0)
+    metrics.flush_to_gcs()
+    url = start_dashboard()
+    try:
+        text = urllib.request.urlopen(url + "/metrics", timeout=30).read(
+        ).decode()
+        assert '# TYPE prom_requests counter' in text
+        assert 'prom_requests{route="/x"} 3.0' in text
+        assert 'prom_lat_bucket' in text and 'le="+Inf"' in text
+        assert 'prom_lat_count' in text
+    finally:
+        stop_dashboard()
