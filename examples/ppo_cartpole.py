@@ -1,0 +1,38 @@
+"""PPO on CartPole-v1 (BASELINE RL config #1).
+
+python examples/ppo_cartpole.py [--impala]
+"""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--impala", action="store_true",
+                   help="async IMPALA instead of PPO")
+    p.add_argument("--target", type=float, default=450.0)
+    args = p.parse_args()
+
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig, PPOConfig
+
+    ray_tpu.init(num_cpus=4)
+    cfg = (IMPALAConfig if args.impala else PPOConfig)(
+        env="CartPole-v1", num_workers=2, rollout_len=1024,
+    )
+    if not args.impala:
+        cfg.lr = 1e-3
+    algo = cfg.build()
+    try:
+        for i in range(200):
+            r = algo.train()
+            print(i, round(r["episode_reward_mean"], 1))
+            if r["episode_reward_mean"] >= args.target:
+                print("solved")
+                break
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()

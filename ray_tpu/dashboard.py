@@ -88,9 +88,7 @@ def _prometheus_text() -> str:
 
     lines = []
     for name, m in sorted(metrics.collect_cluster_metrics().items()):
-        mtype = {"counter": "counter", "gauge": "gauge",
-                 "histogram": "histogram"}[m["type"]]
-        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"# TYPE {name} {m['type']}")
         # bucket bounds travel with the aggregated snapshot (the histogram
         # may have been created in another process)
         bounds = m.get("boundaries") or []

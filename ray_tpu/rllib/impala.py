@@ -127,7 +127,7 @@ class IMPALA:
             vs, pg_adv = jax.lax.stop_gradient(
                 vtrace(
                     batch["logp"], target_logp, batch["rewards"],
-                    jax.lax.stop_gradient(values), batch["next_values"],
+                    values, batch["next_values"],
                     batch["terminals"], batch["cuts"],
                     c.gamma, c.rho_bar, c.c_bar,
                 )

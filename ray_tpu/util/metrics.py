@@ -166,6 +166,11 @@ def collect_cluster_metrics() -> Dict[str, Dict]:
             )
             if "boundaries" in snap:  # histograms: carried for renderers
                 agg.setdefault("boundaries", snap["boundaries"])
+                if agg["boundaries"] != snap["boundaries"]:
+                    # mismatched boundary sets cannot be merged coherently
+                    # (a partially rolled-out change): skip this snapshot's
+                    # values rather than corrupt bucket counts
+                    continue
             for tags, val in snap["values"]:
                 tkey = tuple(tuple(t) for t in tags)
                 if snap["type"] in ("counter",):
