@@ -858,13 +858,25 @@ class Raylet:
         return None
 
     def _maybe_spawn_worker(self, tpu: bool = False):
-        # one pending spawn per queued request, bounded by CPU slots
+        # One pending spawn per queued request, bounded by CPU slots — but
+        # the cap governs TASK-serving workers only: actors hold dedicated
+        # workers for life (reference semantics) and are admission-limited
+        # by resources, so counting them here would deadlock actor creation
+        # once `cap` actors exist.
+        # Count only the REQUESTED flavor (tpu-env vs clean-env): idle
+        # workers of the other flavor must not starve this request (they
+        # can't serve it — _pop_idle_worker is flavor-matched).
         starting = sum(
-            1 for w in self.workers.values() if not w.registered.is_set()
+            1 for w in self.workers.values()
+            if not w.registered.is_set() and w.tpu == tpu
         )
-        busy = len(self.leases)
+        busy_tasks = sum(
+            1 for lease in self.leases.values()
+            if lease.worker.actor_id is None and lease.worker.tpu == tpu
+        )
+        idle_flavor = sum(1 for w in self.idle if w.tpu == tpu)
         cap = max(int(self.total_resources.get("CPU", 1)), 1) + 2
-        if starting + busy + len(self.idle) < cap:
+        if starting + busy_tasks + idle_flavor < cap:
             self._start_worker_process(tpu=tpu)
 
     async def rpc_return_worker(self, conn, data):

@@ -1,0 +1,90 @@
+"""Scalability-envelope tests (scaled-down reference release/benchmarks).
+
+Parity surfaces: reference ``release/benchmarks/README.md`` rows — queued
+tasks on one node, many actors, object args to a single task, returns from
+a single task, many objects in one get. Scaled to this box (1 core) while
+still exercising the same code paths (queue depth, arg resolution fan-in,
+return fan-out).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_scale():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_thousands_of_queued_tasks(rt_scale):
+    """5k tasks queued at once on a 4-CPU node all complete (envelope row:
+    1M+ queued tasks on one 64-core node)."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    refs = [inc.remote(i) for i in range(5000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == [i + 1 for i in range(5000)]
+
+
+def test_many_object_args_to_single_task(rt_scale):
+    """500 ObjectRef args resolved into one task (envelope row: 10k+)."""
+    refs = [ray_tpu.put(i) for i in range(500)]
+
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    assert ray_tpu.get(total.remote(*refs), timeout=300) == sum(range(500))
+
+
+def test_many_returns_from_single_task(rt_scale):
+    """200 returns from one task (envelope row: 3k+)."""
+
+    @ray_tpu.remote(num_returns=200)
+    def spray():
+        return tuple(range(200))
+
+    refs = spray.remote()
+    assert ray_tpu.get(list(refs), timeout=300) == list(range(200))
+
+
+def test_many_objects_single_get(rt_scale):
+    """2k plasma objects in one get (envelope row: 10k+)."""
+    refs = [
+        ray_tpu.put(np.full(2048, i, dtype=np.int32)) for i in range(2000)
+    ]
+    out = ray_tpu.get(refs, timeout=600)
+    assert all(int(a[0]) == i for i, a in enumerate(out))
+
+
+def test_many_actors(rt_scale):
+    """50 concurrent actors on one node (envelope row: 40k+ cluster-wide;
+    here bounded by process count on a 1-core box)."""
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Echo:
+        def __init__(self, i):
+            self.i = i
+
+        def whoami(self):
+            return self.i
+
+    actors = [Echo.remote(i) for i in range(50)]
+    out = ray_tpu.get([a.whoami.remote() for a in actors], timeout=600)
+    assert sorted(out) == list(range(50))
+
+
+def test_large_single_object(rt_scale):
+    """One ~200MB object through put/get intact (envelope row: 100GiB+)."""
+    big = np.arange(25_000_000, dtype=np.float64)  # 200MB
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref, timeout=300)
+    assert out.shape == big.shape
+    assert float(out[12_345_678]) == 12_345_678.0
