@@ -1,13 +1,16 @@
-"""Distributed FIFO queue backed by a named actor.
+"""Distributed FIFO queue backed by an async actor.
 
 Parity: reference ``python/ray/util/queue.py`` — Queue with put/get/
 put_nowait/get_nowait/qsize/empty/full usable from any worker/driver.
+Blocking put/get PARK inside the queue actor (async-def methods run
+concurrently on the actor's asyncio loop), so a blocked consumer costs one
+outstanding RPC — no polling traffic.
 """
 
 from __future__ import annotations
 
-import time
-from typing import Any, List, Optional
+import asyncio
+from typing import Optional
 
 import ray_tpu
 
@@ -21,27 +24,58 @@ class Full(Exception):
 
 
 class _QueueActor:
+    """Async actor: waiters park on asyncio primitives inside. EVERY method
+    is async-def so all queue access happens on the actor's event loop —
+    asyncio.Queue is not thread-safe, and a sync method would run on a
+    to_thread executor thread (and its wakeups would not rouse an idle
+    loop)."""
+
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
-        self._items: List[Any] = []
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
 
-    def put(self, item) -> bool:
-        if self.maxsize > 0 and len(self._items) >= self.maxsize:
+    async def put(self, item, timeout: Optional[float]) -> bool:
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
             return False
-        self._items.append(item)
-        return True
 
-    def get(self):
-        if not self._items:
+    async def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get(self, timeout: Optional[float]):
+        try:
+            if timeout is None:
+                return ("ok", await self._q.get())
+            return ("ok", await asyncio.wait_for(self._q.get(), timeout))
+        except asyncio.TimeoutError:
             return ("empty",)
-        return ("ok", self._items.pop(0))
 
-    def qsize(self) -> int:
-        return len(self._items)
+    async def get_nowait(self):
+        try:
+            return ("ok", self._q.get_nowait())
+        except asyncio.QueueEmpty:
+            return ("empty",)
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
 
 
 class Queue:
     """Picklable distributed queue (pass it into tasks/actors freely)."""
+
+    # Effectively unbounded: parked waiters hold concurrency slots for
+    # their whole wait, so a small cap would DEADLOCK once that many
+    # blocked getters exist (the releasing put could never run).
+    _CONCURRENCY = 1_000_000
 
     def __init__(self, maxsize: int = 0, *, _actor=None):
         if _actor is not None:
@@ -49,45 +83,37 @@ class Queue:
             self.maxsize = maxsize
             return
         self.maxsize = maxsize
-        cls = ray_tpu.remote(num_cpus=0.1)(_QueueActor)
+        cls = ray_tpu.remote(
+            num_cpus=0.1, max_concurrency=self._CONCURRENCY
+        )(_QueueActor)
         self._actor = cls.remote(maxsize)
-
-    # NOTE: blocking put/get poll the queue actor with exponential backoff
-    # (10ms -> 200ms). Parking the request inside the actor would be ideal,
-    # but our actors execute methods serially — a parked get would block the
-    # matching put. Revisit when async actors land.
 
     def put(self, item, block: bool = True,
             timeout: Optional[float] = None) -> None:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.01
-        while True:
-            ok = ray_tpu.get(self._actor.put.remote(item), timeout=60)
-            if ok:
-                return
-            if not block or (
-                deadline is not None and time.monotonic() > deadline
-            ):
-                raise Full("queue full")
-            time.sleep(delay)
-            delay = min(delay * 1.5, 0.2)
+        if not block:
+            ok = ray_tpu.get(self._actor.put_nowait.remote(item), timeout=60)
+        else:
+            rpc_timeout = None if timeout is None else timeout + 30
+            ok = ray_tpu.get(
+                self._actor.put.remote(item, timeout), timeout=rpc_timeout
+            )
+        if not ok:
+            raise Full("queue full")
 
     def put_nowait(self, item) -> None:
         self.put(item, block=False)
 
     def get(self, block: bool = True, timeout: Optional[float] = None):
-        deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.01
-        while True:
-            out = ray_tpu.get(self._actor.get.remote(), timeout=60)
-            if out[0] == "ok":
-                return out[1]
-            if not block or (
-                deadline is not None and time.monotonic() > deadline
-            ):
-                raise Empty("queue empty")
-            time.sleep(delay)
-            delay = min(delay * 1.5, 0.2)
+        if not block:
+            out = ray_tpu.get(self._actor.get_nowait.remote(), timeout=60)
+        else:
+            rpc_timeout = None if timeout is None else timeout + 30
+            out = ray_tpu.get(
+                self._actor.get.remote(timeout), timeout=rpc_timeout
+            )
+        if out[0] != "ok":
+            raise Empty("queue empty")
+        return out[1]
 
     def get_nowait(self):
         return self.get(block=False)

@@ -334,3 +334,43 @@ def test_actor_pool_survives_task_failure(rt):
     assert out == [0, 10, 30, 40]  # order preserved around the failure
     # pool still fully usable afterwards
     assert list(pool.map(lambda a, x: a.f.remote(x), [5, 6])) == [50, 60]
+
+
+def test_queue_parks_blocked_waiters(rt):
+    """Blocked get() parks inside the async queue actor (one outstanding
+    RPC, no polling) and wakes as soon as the producer puts."""
+    import threading
+
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+    got = {}
+
+    def consumer():
+        t0 = time.monotonic()
+        got["value"] = q.get(timeout=30)
+        got["waited"] = time.monotonic() - t0
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(1.0)
+    q.put("wake")
+    t.join(timeout=30)
+    assert got["value"] == "wake"
+    assert 0.9 < got["waited"] < 5.0  # parked, then woken promptly
+
+    # bounded queue: a blocking put parks until space appears
+    qb = Queue(maxsize=1)
+    qb.put(1)
+
+    def spacemaker():
+        time.sleep(0.8)
+        qb.get()
+
+    t2 = threading.Thread(target=spacemaker)
+    t2.start()
+    t0 = time.monotonic()
+    qb.put(2, timeout=30)  # blocks ~0.8s until spacemaker drains
+    assert time.monotonic() - t0 > 0.5
+    t2.join(timeout=30)
+    assert qb.get(timeout=10) == 2
