@@ -138,8 +138,48 @@ class ServeController:
 
     # -- routing table --
 
+    def check_replicas(self, name: str):
+        """Reconcile against the GCS ACTOR TABLE (authoritative liveness —
+        raylets report worker death there): replace DEAD replicas and bump
+        the version so routers drop them. No health pings: a serial replica
+        mid-request cannot answer one, and misclassifying busy as dead
+        would churn replicas forever (parity: reference
+        DeploymentStateManager reconciliation, deployment_state.py:2130)."""
+        dep = self.deployments.get(name)
+        if dep is None:
+            return 0
+        from ray_tpu._private.worker import require_connected
+
+        try:
+            recs = require_connected().gcs.call("list_actors", None)
+        except Exception:
+            return 0
+        state_of = {bytes(r["actor_id"]): r["state"] for r in recs}
+        alive = [
+            r for r in dep["replicas"]
+            if state_of.get(r._actor_id) != "DEAD"
+        ]
+        replaced = len(dep["replicas"]) - len(alive)
+        if replaced:
+            dep["replicas"] = alive
+            self._scale_to(name, len(alive) + replaced)
+            dep["version"] += 1  # force router refresh onto the new set
+        return replaced
+
+    _last_check = 0.0
+
+    def _maybe_check_all(self):
+        """Throttled reconciliation ride-along on router refresh traffic."""
+        now = time.time()
+        if now - self._last_check < 5.0:
+            return
+        self._last_check = now
+        for name in list(self.deployments):
+            self.check_replicas(name)
+
     def get_replicas(self, name: str):
         self._reap_draining()
+        self._maybe_check_all()
         dep = self.deployments.get(name)
         if dep is None:
             return None

@@ -171,12 +171,32 @@ class Router:
                     "argument per request (the batch element)"
                 )
             return self._submit_batched(args, kwargs)
-        idx, replica = self._pick()
-        ref = replica.handle_request.remote(list(args), dict(kwargs or {}))
-        with self._lock:
-            self._outstanding[ref] = idx
+
+        def send():
+            idx, replica = self._pick()
+            ref = replica.handle_request.remote(
+                list(args), dict(kwargs or {})
+            )
+            with self._lock:
+                self._outstanding[ref] = idx
+            return ref
+
+        def recover_and_resend():
+            # replica died: have the controller reconcile (replaces dead
+            # replicas, bumps the version), refresh, re-pick
+            try:
+                ray_tpu.get(
+                    self.controller.check_replicas.remote(self.deployment),
+                    timeout=60,
+                )
+            except Exception:
+                pass
+            self._refresh(force=True)
+            return send()
+
+        ref = send()
         self._report_load()  # after registration: the request is visible
-        return _ResultFuture(ref, lambda: self._release_ref(ref))
+        return _ResultFuture(ref, self._release_ref, recover_and_resend)
 
     # -- batched path --
 
@@ -207,44 +227,85 @@ class Router:
                 self._batch_queue = self._batch_queue[len(batch):]
             if not batch:
                 continue
-            try:
-                idx, replica = self._pick()
-            except Exception as e:
+            self._dispatch_batch(batch, retries_left=1)
+
+    def _dispatch_batch(self, batch, retries_left: int):
+        from ray_tpu.exceptions import ActorDiedError, ActorUnavailableError
+
+        try:
+            idx, replica = self._pick()
+        except Exception as e:
+            for r in batch:
+                r.error = e
+                r.done.set()
+            return
+        try:
+            out = ray_tpu.get(
+                replica.handle_batch.remote([r.payload for r in batch]),
+                timeout=300,
+            )
+            for r, val in zip(batch, out):
+                r.result = val
+                r.done.set()
+        except (ActorDiedError, ActorUnavailableError) as e:
+            # replica died: reconcile, refresh, retry the batch ONCE
+            if retries_left > 0:
+                try:
+                    ray_tpu.get(
+                        self.controller.check_replicas.remote(
+                            self.deployment
+                        ),
+                        timeout=60,
+                    )
+                except Exception:
+                    pass
+                self._refresh(force=True)
+                self._dispatch_batch(batch, retries_left - 1)
+            else:
                 for r in batch:
                     r.error = e
                     r.done.set()
-                continue
-            try:
-                out = ray_tpu.get(
-                    replica.handle_batch.remote(
-                        [r.payload for r in batch]
-                    ),
-                    timeout=300,
-                )
-                for r, val in zip(batch, out):
-                    r.result = val
-                    r.done.set()
-            except Exception as e:
-                for r in batch:
-                    r.error = e
-                    r.done.set()
-            finally:
-                self._release(idx)
+        except Exception as e:
+            for r in batch:
+                r.error = e
+                r.done.set()
+        finally:
+            self._release(idx)
 
 
 class _ResultFuture:
-    def __init__(self, ref, on_done):
+    """Request future with ONE transparent resubmit if the replica died
+    (the request may or may not have started executing — at-least-once on
+    replica failure, the reference router's recovery semantics)."""
+
+    def __init__(self, ref, release_ref, retry=None):
         self._ref = ref
-        self._on_done = on_done
-        self._released = False
+        self._release_ref = release_ref
+        self._retry = retry
 
     def result(self, timeout: Optional[float] = 120.0):
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+        )
+
+        deadline = None if timeout is None else time.monotonic() + timeout
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
+        except (ActorDiedError, ActorUnavailableError):
+            if self._retry is None:
+                raise
+            retry, self._retry = self._retry, None
+            self._release_ref(self._ref)
+            self._ref = retry()
+            # honor the CALLER's deadline: recovery already spent part of it
+            remaining = (
+                None if deadline is None
+                else max(1.0, deadline - time.monotonic())
+            )
+            return ray_tpu.get(self._ref, timeout=remaining)
         finally:
-            if not self._released:
-                self._released = True
-                self._on_done()
+            self._release_ref(self._ref)
 
 
 class _LocalFuture:
