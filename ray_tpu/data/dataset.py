@@ -15,18 +15,50 @@ import ray_tpu
 from ray_tpu.data.streaming import Stage, StreamingExecutor
 
 
-def batches_from_blocks(block_iter: Iterator[List],
-                        batch_size: int) -> Iterator[List]:
+def batches_from_blocks(block_iter: Iterator[List], batch_size: int,
+                        batch_format: str = "rows") -> Iterator:
     """Re-chunk a stream of blocks into fixed-size batches (tail partial).
-    Shared by Dataset.iter_batches and DataIterator.iter_batches."""
-    buf: List = []
-    for block in block_iter:
-        buf.extend(block)
-        while len(buf) >= batch_size:
-            yield buf[:batch_size]
-            buf = buf[batch_size:]
-    if buf:
-        yield buf
+    Shared by Dataset.iter_batches and DataIterator.iter_batches.
+
+    batch_format: "rows" yields lists of items; "numpy" collates dict rows
+    into one dict of stacked arrays per batch (the device-put-ready form —
+    parity: reference iter_batches(batch_format="numpy")).
+    """
+    # validate at CALL time (a generator would defer the error to first
+    # iteration, far from the bad call site)
+    if batch_format not in ("rows", "numpy"):
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def emit(rows):
+        if batch_format == "rows":
+            return rows
+        import numpy as np
+
+        if not rows or not isinstance(rows[0], dict):
+            return np.stack([np.asarray(r) for r in rows])
+        keys = set(rows[0])
+        for r in rows:
+            if set(r) != keys:
+                raise ValueError(
+                    "inconsistent batch schema for batch_format='numpy': "
+                    f"row keys {sorted(set(r))} vs {sorted(keys)}"
+                )
+        return {
+            k: np.stack([np.asarray(r[k]) for r in rows])
+            for k in rows[0]
+        }
+
+    def gen():
+        buf: List = []
+        for block in block_iter:
+            buf.extend(block)
+            while len(buf) >= batch_size:
+                yield emit(buf[:batch_size])
+                buf = buf[batch_size:]
+        if buf:
+            yield emit(buf)
+
+    return gen()
 
 
 class Dataset:
@@ -101,8 +133,11 @@ class Dataset:
         for block in self.iter_blocks(**kw):
             yield from block
 
-    def iter_batches(self, batch_size: int = 256, **kw) -> Iterator[List]:
-        return batches_from_blocks(self.iter_blocks(**kw), batch_size)
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "rows", **kw) -> Iterator:
+        return batches_from_blocks(
+            self.iter_blocks(**kw), batch_size, batch_format
+        )
 
     def take(self, n: int = 20) -> List:
         out = []

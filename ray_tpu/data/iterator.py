@@ -101,7 +101,10 @@ class DataIterator:
         for block in self.iter_blocks():
             yield from block
 
-    def iter_batches(self, batch_size: int = 256) -> Iterator[List]:
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "rows") -> Iterator:
         from ray_tpu.data.dataset import batches_from_blocks
 
-        return batches_from_blocks(self.iter_blocks(), batch_size)
+        return batches_from_blocks(
+            self.iter_blocks(), batch_size, batch_format
+        )

@@ -145,3 +145,21 @@ def test_streaming_split_feeds_trainer(rt_data, tmp_path):
     assert sum(m["total"] for m in Sum2.rows.values()) == sum(
         2.0 * i for i in range(40)
     )
+
+
+def test_iter_batches_numpy_format(rt_data):
+    import numpy as np
+
+    ds = rd.from_items(
+        [{"x": np.full(4, i, np.float32), "y": i} for i in range(10)],
+        parallelism=2,
+    )
+    batches = list(ds.iter_batches(batch_size=4, batch_format="numpy"))
+    assert [b["x"].shape for b in batches] == [(4, 4), (4, 4), (2, 4)]
+    assert batches[0]["y"].tolist() == [0, 1, 2, 3]
+    # scalar rows stack into a plain array
+    ds2 = rd.range(6, parallelism=2)
+    out = list(ds2.iter_batches(batch_size=3, batch_format="numpy"))
+    assert sorted(np.concatenate(out).tolist()) == [0, 1, 2, 3, 4, 5]
+    with pytest.raises(ValueError, match="batch_format"):
+        list(ds.iter_batches(batch_format="arrow"))
