@@ -1265,7 +1265,13 @@ class CoreWorker:
             self._actor_pumping.discard(spec.actor_id)
 
     async def _actor_address(self, actor_id: bytes, wait_alive=True):
-        deadline = time.monotonic() + 60
+        """Resolve an actor's address. While the actor is PENDING/RESTARTING
+        and ``wait_alive``, waits INDEFINITELY (reference semantics: calls on
+        a not-yet-placed actor block until placement — the GCS owns the
+        timeout-vs-infeasible decision, not the caller). Returns the DEAD
+        record when dead; None only when no record exists (or when
+        ``wait_alive=False`` and the actor is not yet ALIVE)."""
+        sleep = 0.05
         while True:
             rec = await self.gcs.conn.call_async("get_actor", actor_id, timeout=30)
             if rec is None:
@@ -1280,9 +1286,10 @@ class CoreWorker:
                 return rec["address"]
             if rec["state"] == "DEAD":
                 return rec
-            if not wait_alive or time.monotonic() > deadline:
+            if not wait_alive:
                 return None
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(sleep)
+            sleep = min(0.25, sleep * 1.5)
 
     async def _submit_actor_async(self, spec: TaskSpec):
         if spec.task_id in self._cancelled:
@@ -1302,12 +1309,8 @@ class CoreWorker:
             addr = self._actor_addr_cache.get(spec.actor_id)
             if addr is None:
                 got = await self._actor_address(spec.actor_id)
-                if got is None and spec.max_retries != 0:
-                    # still RESTARTING past the address deadline and the
-                    # user opted into retries: keep waiting (a DEAD record
-                    # — restarts exhausted — exits via the branch below)
-                    await asyncio.sleep(1.0)
-                    continue
+                # None now means "no record at all" (GCS lost/never had it);
+                # pending/restarting waits happen inside _actor_address
                 if got is None or isinstance(got, dict) and got.get("state") == "DEAD":
                     cause = got.get("death_cause", "") if isinstance(got, dict) else ""
                     self._fail_task(

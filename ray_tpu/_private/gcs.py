@@ -430,25 +430,58 @@ class GcsServer:
     async def _place_actor(self, rec: ActorRecord, delay: float = 0.0):
         if delay:
             await asyncio.sleep(delay)
+        from ray_tpu._private.protocol import parse_pg_strategy
+
         spec = rec.spec
-        deadline = time.monotonic() + 60.0
+        strategy = spec.get("scheduling_strategy")
+        # An actor stays PENDING while some alive node could EVER satisfy it
+        # (reference: pending actors wait for resources indefinitely,
+        # gcs_actor_scheduler.h:111 — busy != infeasible). Only a request no
+        # alive node's TOTAL resources cover fails, after a grace window for
+        # nodes to join. PG-strategy and hard-affinity placements wait
+        # INDEFINITELY: a pending placement group or temporarily-gone target
+        # node is "not yet", never "infeasible" (their own lifecycles decide).
+        waits_forever = parse_pg_strategy(strategy) is not None or (
+            isinstance(strategy, (list, tuple))
+            and strategy and strategy[0] == "affinity"
+            and not bool(strategy[2])  # hard affinity
+        )
+        grace = GLOBAL_CONFIG.infeasible_task_grace_s
+        infeasible_deadline = time.monotonic() + grace
+        # Separately bound *persistent placement errors* (raylet RPC raising
+        # or rejecting for a reason other than "busy"): those indicate a
+        # wedged node, not a full one, and must surface instead of hanging
+        # every caller forever. Reset whenever an attempt is healthy.
+        error_deadline = None
+        BUSY_ERRORS = ("no worker available", "bundle not on this node / full")
         while rec.state in (PENDING, RESTARTING):
             node_id = self._pick_node_for(
-                spec.get("resources") or {},
-                strategy=spec.get("scheduling_strategy"),
+                spec.get("resources") or {}, strategy=strategy
             )
             raylet = self._raylet_clients.get(node_id) if node_id else None
             if raylet is None or raylet.closed:
-                if time.monotonic() > deadline:
-                    await self._fail_actor(rec, "no node can host this actor")
+                if not waits_forever and time.monotonic() > infeasible_deadline:
+                    await self._fail_actor(
+                        rec,
+                        "infeasible: no alive node can satisfy actor "
+                        f"resources {spec.get('resources')}",
+                    )
                     return
                 await asyncio.sleep(0.2)
                 continue
+            infeasible_deadline = time.monotonic() + grace
             try:
                 reply = await raylet.call_async("create_actor", spec, timeout=120)
             except Exception as e:
                 logger.warning("actor placement on %s failed: %s",
                                node_id.hex()[:12], e)
+                if error_deadline is None:
+                    error_deadline = time.monotonic() + 120.0
+                elif time.monotonic() > error_deadline:
+                    await self._fail_actor(
+                        rec, f"placement kept failing: {e!r}"
+                    )
+                    return
                 await asyncio.sleep(0.2)
                 continue
             if reply.get("ok"):
@@ -472,9 +505,20 @@ class GcsServer:
             if reply.get("fatal"):
                 await self._fail_actor(rec, reply.get("error", "creation failed"))
                 return
-            if time.monotonic() > deadline:
-                await self._fail_actor(rec, reply.get("error", "placement failed"))
-                return
+            err = reply.get("error", "")
+            if err in BUSY_ERRORS:
+                # busy node (lease parked then timed out): stay PENDING,
+                # retry forever; a healthy-but-full attempt clears the
+                # error bound
+                error_deadline = None
+            else:
+                if error_deadline is None:
+                    error_deadline = time.monotonic() + 120.0
+                elif time.monotonic() > error_deadline:
+                    await self._fail_actor(
+                        rec, err or "placement kept failing"
+                    )
+                    return
             await asyncio.sleep(0.2)
 
     async def _fail_actor(self, rec: ActorRecord, reason: str):

@@ -5,25 +5,43 @@ over the task/object plane (parity: reference ``python/ray/data/``; see
 dataset.py / streaming.py for the component mapping). Typical TPU use:
 
     import ray_tpu.data as rd
-    ds = rd.from_items(samples).map_batches(preprocess)
+    ds = rd.read_parquet("gs://...").map_batches(preprocess)
     shards = ds.streaming_split(scaling.num_workers)
     # each JaxTrainer worker:  for batch in shard.iter_batches(...): ...
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
     Dataset,
+    GroupedData,
     from_items,
     range,  # noqa: A004 — parity with ray.data.range
     read_binary_files,
     read_text,
+)
+from ray_tpu.data.io import (  # noqa: F401
+    from_arrow,
+    from_numpy,
+    from_pandas,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 
 __all__ = [
     "Dataset",
     "DataIterator",
+    "GroupedData",
     "from_items",
+    "from_arrow",
+    "from_numpy",
+    "from_pandas",
     "range",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
     "read_text",
     "read_binary_files",
 ]

@@ -9,7 +9,7 @@ the StreamingExecutor (streaming.py) with bounded buffering.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.data.streaming import Stage, StreamingExecutor
@@ -62,11 +62,31 @@ def batches_from_blocks(block_iter: Iterator[List], batch_size: int,
 
 
 class Dataset:
-    """Lazy pipeline: source block refs + a chain of per-block stages."""
+    """Lazy pipeline: source block refs + a chain of per-block stages.
 
-    def __init__(self, source_refs: List, stages: Optional[List[Stage]] = None):
-        self._source_refs = source_refs
+    A Dataset may instead carry a ``source_factory`` — a thunk producing the
+    source refs on first consumption. Barrier ops (shuffle/sort/groupby/...)
+    use this so that *calling* them stays lazy (reference semantics: the
+    plan executes on iteration, not construction); the factory result is
+    cached, so repeated iteration does not re-execute the exchange.
+    """
+
+    def __init__(self, source_refs: Optional[List] = None,
+                 stages: Optional[List[Stage]] = None,
+                 source_factory: Optional[Callable[[], List]] = None):
+        if (source_refs is None) == (source_factory is None):
+            raise ValueError(
+                "exactly one of source_refs / source_factory required"
+            )
+        self._source = source_refs
+        self._source_factory = source_factory
         self._stages = stages or []
+
+    @property
+    def _source_refs(self) -> List:
+        if self._source is None:
+            self._source = self._source_factory()
+        return self._source
 
     # ---------------- transforms (lazy) ----------------
 
@@ -96,29 +116,186 @@ class Dataset:
             name="filter", **kw,
         )
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Block-order + intra-block shuffle (approximate global shuffle;
-        the reference's exact shuffle is push-based — future work)."""
-        import builtins
-        import random as _random
-
-        rng = _random.Random(seed)
-        order = list(builtins.range(len(self._source_refs)))
-        rng.shuffle(order)
-        shuffled = [self._source_refs[i] for i in order]
-        blk_seed = rng.randrange(1 << 30)
-
-        def shuf(block, idx, _s=blk_seed):
-            # distinct permutation per block: seed mixes the block index
-            r = _random.Random(_s * 1000003 + idx)
-            out = list(block)
-            r.shuffle(out)
-            return out
-
-        return Dataset(
-            shuffled,
-            self._stages + [Stage("shuffle", shuf, with_index=True)],
+    def flat_map(self, fn: Callable[[Any], List[Any]], **kw) -> "Dataset":
+        return self.map_batches(
+            lambda block, _fn=fn: [y for x in block for y in _fn(x)],
+            name="flat_map", **kw,
         )
+
+    def select_columns(self, cols: List[str], **kw) -> "Dataset":
+        return self.map_batches(
+            lambda block, _c=tuple(cols): [
+                {k: r[k] for k in _c} for r in block
+            ],
+            name="select_columns", **kw,
+        )
+
+    def drop_columns(self, cols: List[str], **kw) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda block, _d=drop: [
+                {k: v for k, v in r.items() if k not in _d} for r in block
+            ],
+            name="drop_columns", **kw,
+        )
+
+    def add_column(self, name: str, fn: Callable[[Any], Any],
+                   **kw) -> "Dataset":
+        def add(block, _n=name, _fn=fn):
+            return [{**r, _n: _fn(r)} for r in block]
+
+        return self.map_batches(add, name="add_column", **kw)
+
+    # ---------------- all-to-all ops (pipeline barriers) ----------------
+
+    def _materialized_refs(self) -> List:
+        return list(self._executor().iter_output_refs())
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """EXACT global shuffle via two-phase map-partition / reduce-merge
+        (reference push_based_shuffle.py semantics; a barrier op — executes
+        lazily on first consumption)."""
+        from ray_tpu.data.shuffle import exact_shuffle
+
+        def build():
+            refs = self._materialized_refs()
+            return exact_shuffle(refs, max(1, len(refs)), seed)
+
+        return Dataset(source_factory=build)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        from ray_tpu.data.shuffle import repartition_blocks
+
+        return Dataset(source_factory=lambda: repartition_blocks(
+            self._materialized_refs(), num_blocks
+        ))
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        """Distributed sort (sampled range partition + per-partition sort);
+        output is globally ordered across blocks. Lazy barrier."""
+        from ray_tpu.data.shuffle import make_keyfn, sort_blocks
+
+        def build():
+            refs = self._materialized_refs()
+            return sort_blocks(
+                refs, make_keyfn(key), descending, max(1, len(refs))
+            )
+
+        return Dataset(source_factory=build)
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        def build():
+            refs = list(self._materialized_refs())
+            for o in others:
+                refs.extend(o._materialized_refs())
+            return refs
+
+        return Dataset(source_factory=build)
+
+    def limit(self, n: int) -> "Dataset":
+        """Truncate to the first n rows (lazy; on consumption, stops pulling
+        upstream blocks once n rows have materialized)."""
+
+        def build():
+            out_refs, count = [], 0
+            for ref in self._executor().iter_output_refs():
+                block = ray_tpu.get(ref)
+                if count + len(block) <= n:
+                    out_refs.append(ref)
+                    count += len(block)
+                else:
+                    out_refs.append(ray_tpu.put(block[: n - count]))
+                    count = n
+                if count >= n:
+                    break
+            return out_refs or [ray_tpu.put([])]
+
+        return Dataset(source_factory=build)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets of near-equal row counts (materializing)."""
+        import builtins
+
+        from ray_tpu.data.shuffle import repartition_blocks
+
+        refs = repartition_blocks(self._materialized_refs(), n)
+        return [Dataset([r]) for r in refs[:n]] + [
+            Dataset([ray_tpu.put([])])
+            for _ in builtins.range(n - len(refs))
+        ]
+
+    # ---------------- aggregates ----------------
+
+    def _column_values(self, on: Optional[str]) -> Iterator[Any]:
+        for row in self.iter_rows():
+            yield row[on] if on is not None else row
+
+    def sum(self, on: Optional[str] = None):
+        return sum(self._column_values(on))
+
+    def min(self, on: Optional[str] = None):
+        return min(self._column_values(on))
+
+    def max(self, on: Optional[str] = None):
+        return max(self._column_values(on))
+
+    def mean(self, on: Optional[str] = None):
+        total, n = 0.0, 0
+        for v in self._column_values(on):
+            total += v
+            n += 1
+        if not n:
+            raise ValueError("mean() of an empty dataset")
+        return total / n
+
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        import math
+
+        vals = list(self._column_values(on))
+        n = len(vals)
+        if n <= ddof:
+            raise ValueError("std() needs more rows than ddof")
+        m = sum(vals) / n
+        return math.sqrt(sum((v - m) ** 2 for v in vals) / (n - ddof))
+
+    def schema(self) -> Optional[Dict[str, type]]:
+        """Column name -> type from the first non-empty block (dict rows);
+        non-dict rows report {'value': type}."""
+        for block in self.iter_blocks():
+            if block:
+                row = block[0]
+                if isinstance(row, dict):
+                    return {k: type(v) for k, v in row.items()}
+                return {"value": type(row)}
+        return None
+
+    # ---------------- sinks ----------------
+
+    def to_pandas(self):
+        import pandas as pd
+
+        rows = self.take_all()
+        if rows and not isinstance(rows[0], dict):
+            rows = [{"value": r} for r in rows]
+        return pd.DataFrame(rows)
+
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data.io import write_dataset
+
+        return write_dataset(self, path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data.io import write_dataset
+
+        return write_dataset(self, path, "json")
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data.io import write_dataset
+
+        return write_dataset(self, path, "parquet")
 
     # ---------------- execution ----------------
 
@@ -179,6 +356,53 @@ class Dataset:
     def __repr__(self):
         names = " -> ".join(s.name for s in self._stages) or "source"
         return f"Dataset({len(self._source_refs)} blocks: {names})"
+
+
+class GroupedData:
+    """``ds.groupby(key)`` result (reference GroupedData, grouped_data.py):
+    hash-partitioned exact aggregation — each key reduced exactly once."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _reduce(self, name: str,
+                reducefn: Callable[[Any, List], Any]) -> Dataset:
+        from ray_tpu.data.shuffle import groupby_reduce, make_keyfn
+
+        def build():
+            refs = self._ds._materialized_refs()
+            return groupby_reduce(refs, make_keyfn(self._key), reducefn,
+                                  max(1, len(refs)))
+
+        return Dataset(source_factory=build)
+
+    def count(self) -> Dataset:
+        return self._reduce(
+            "count", lambda k, rows: {"key": k, "count": len(rows)}
+        )
+
+    def _col_agg(self, name: str, on: str, agg) -> Dataset:
+        def red(k, rows, _on=on, _agg=agg, _n=name):
+            return {"key": k, f"{_n}({_on})": _agg([r[_on] for r in rows])}
+
+        return self._reduce(name, red)
+
+    def sum(self, on: str) -> Dataset:
+        return self._col_agg("sum", on, sum)
+
+    def min(self, on: str) -> Dataset:
+        return self._col_agg("min", on, min)
+
+    def max(self, on: str) -> Dataset:
+        return self._col_agg("max", on, max)
+
+    def mean(self, on: str) -> Dataset:
+        return self._col_agg("mean", on, lambda vs: sum(vs) / len(vs))
+
+    def map_groups(self, fn: Callable[[List], Any]) -> Dataset:
+        """fn(group_rows) -> one output item per group."""
+        return self._reduce("map_groups", lambda k, rows, _f=fn: _f(rows))
 
 
 # ---------------- sources (parity: read_api.py) ----------------

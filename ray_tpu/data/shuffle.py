@@ -1,0 +1,252 @@
+"""All-to-all Data operations: exact shuffle, sort, repartition, groupby.
+
+Parity: reference ``python/ray/data/_internal/planner/exchange/`` and
+``push_based_shuffle.py`` / ``sort.py`` — the two-phase map-partition /
+reduce-merge exchange. These are pipeline *barriers* in the reference too
+(an all-to-all op consumes its whole input before emitting); here the
+upstream plan is executed (streaming, so driver memory stays bounded —
+blocks land in the object store, not on the driver), then a map stage
+partitions every block into P parts (``num_returns=P`` tasks) and a reduce
+stage merges part ``p`` of every map output. Only refs flow through the
+driver; rows move worker-to-worker through the object plane.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+
+
+# ---------------- task bodies (run on workers) ----------------
+
+
+def _rets(parts: List[List]):
+    """num_returns=N tasks return an N-tuple; num_returns=1 tasks return
+    the single value itself (not a 1-tuple)."""
+    return parts[0] if len(parts) == 1 else tuple(parts)
+
+
+def _partition_random(block: List, nparts: int, seed: int):
+    rng = _random.Random(seed)
+    parts: List[List] = [[] for _ in range(nparts)]
+    for row in block:
+        parts[rng.randrange(nparts)].append(row)
+    return _rets(parts)
+
+
+def _partition_by_key(block: List, boundaries: List, keyfn) -> tuple:
+    """Range partition: part i gets rows with boundaries[i-1] <= key <
+    boundaries[i] (P = len(boundaries)+1 parts)."""
+    import bisect
+
+    nparts = len(boundaries) + 1
+    parts: List[List] = [[] for _ in range(nparts)]
+    for row in block:
+        parts[bisect.bisect_right(boundaries, keyfn(row))].append(row)
+    return _rets(parts)
+
+
+def _stable_hash(v) -> int:
+    """Deterministic across processes (str/bytes hash() is randomized by
+    PYTHONHASHSEED; map tasks run in different workers, so the partition of
+    a key must not depend on process identity)."""
+    import zlib
+
+    if isinstance(v, str):
+        return zlib.crc32(v.encode("utf-8", "surrogatepass"))
+    if isinstance(v, (bytes, bytearray)):
+        return zlib.crc32(bytes(v))
+    if isinstance(v, tuple):
+        h = 1469598103
+        for item in v:
+            h = (h * 1099511628211 ^ _stable_hash(item)) & ((1 << 64) - 1)
+        return h
+    if isinstance(v, (int, float, bool)) or v is None:
+        return hash(v)  # numeric hash is not randomized
+    return zlib.crc32(repr(v).encode())
+
+
+def _partition_by_hash(block: List, nparts: int, keyfn):
+    parts: List[List] = [[] for _ in range(nparts)]
+    for row in block:
+        h = _stable_hash(keyfn(row))
+        parts[(h ^ (h >> 16)) % nparts].append(row)
+    return _rets(parts)
+
+
+def _merge_shuffle(seed: int, *parts) -> List:
+    out: List = []
+    for p in parts:
+        out.extend(p)
+    _random.Random(seed).shuffle(out)
+    return out
+
+
+def _merge_sort(keyfn, descending: bool, *parts) -> List:
+    out: List = []
+    for p in parts:
+        out.extend(p)
+    out.sort(key=keyfn, reverse=descending)
+    return out
+
+
+def _merge_groups(keyfn, reducefn, *parts) -> List:
+    """Group rows by key within this partition (hash partitioning guarantees
+    a key lives in exactly one partition) and reduce each group."""
+    groups: dict = {}
+    for p in parts:
+        for row in p:
+            groups.setdefault(keyfn(row), []).append(row)
+    try:
+        items = sorted(groups.items())
+    except TypeError:  # unorderable key mix — keep insertion order
+        items = list(groups.items())
+    return [reducefn(k, rows) for k, rows in items]
+
+
+def _sample_keys(block: List, k: int, seed: int, keyfn) -> List:
+    rng = _random.Random(seed)
+    n = len(block)
+    if n <= k:
+        return [keyfn(r) for r in block]
+    return [keyfn(block[rng.randrange(n)]) for _ in range(k)]
+
+
+def _slice_concat(ranges, *blocks) -> List:
+    """ranges[i] = (start, end) row slice to take from blocks[i]."""
+    out: List = []
+    for (start, end), block in zip(ranges, blocks):
+        out.extend(block[start:end])
+    return out
+
+
+# ---------------- driver-side exchange plans ----------------
+
+
+def _as_list(refs_or_ref, nparts: int) -> List:
+    """num_returns=1 tasks return a bare ObjectRef, not a 1-list."""
+    return [refs_or_ref] if nparts == 1 else refs_or_ref
+
+
+def _exchange(refs: List, partition_task, partition_args,
+              merge_task, merge_args, nparts: int) -> List:
+    """Generic two-phase exchange. Returns reduce-output refs."""
+    part = ray_tpu.remote(num_cpus=1)(partition_task).options(
+        num_returns=nparts
+    )
+    map_outs = [
+        _as_list(part.remote(r, *partition_args), nparts) for r in refs
+    ]
+    merge = ray_tpu.remote(num_cpus=1)(merge_task)
+    out = []
+    for p in range(nparts):
+        cols = [mo[p] for mo in map_outs]
+        out.append(merge.remote(*merge_args, *cols))
+    return out
+
+
+def exact_shuffle(refs: List, nparts: int, seed: Optional[int]) -> List:
+    """Exact global random shuffle (reference random_shuffle semantics:
+    every output permutation equally likely up to rng quality)."""
+    if not refs:
+        return refs
+    base = seed if seed is not None else _random.randrange(1 << 30)
+    part = ray_tpu.remote(num_cpus=1)(_partition_random).options(
+        num_returns=nparts
+    )
+    map_outs = [
+        _as_list(part.remote(r, nparts, base * 1000003 + i), nparts)
+        for i, r in enumerate(refs)
+    ]
+    merge = ray_tpu.remote(num_cpus=1)(_merge_shuffle)
+    return [
+        merge.remote(base * 7 + p, *[mo[p] for mo in map_outs])
+        for p in range(nparts)
+    ]
+
+
+def sort_blocks(refs: List, keyfn: Callable[[Any], Any],
+                descending: bool, nparts: int) -> List:
+    """Distributed sort via sampled range partitioning; output blocks are
+    globally ordered (block i entirely <= block i+1)."""
+    if not refs:
+        return refs
+    sample = ray_tpu.remote(num_cpus=1)(_sample_keys)
+    samples: List = []
+    for i, r in enumerate(refs):
+        samples.append(sample.remote(r, 32, 1299721 * (i + 1), keyfn))
+    keys = sorted(k for s in ray_tpu.get(samples) for k in s)
+    if not keys:
+        return refs
+    # P-1 boundaries at even quantiles of the sample
+    boundaries = [
+        keys[min(len(keys) - 1, (len(keys) * (i + 1)) // nparts)]
+        for i in range(nparts - 1)
+    ]
+    if descending:
+        out = _exchange(
+            refs, _partition_by_key, (boundaries, keyfn),
+            _merge_sort, (keyfn, True), nparts,
+        )
+        return list(reversed(out))
+    return _exchange(
+        refs, _partition_by_key, (boundaries, keyfn),
+        _merge_sort, (keyfn, False), nparts,
+    )
+
+
+def groupby_reduce(refs: List, keyfn: Callable[[Any], Any],
+                   reducefn: Callable[[Any, List], Any],
+                   nparts: int) -> List:
+    """Hash-partition by key, then reduce each group exactly once."""
+    if not refs:
+        return refs
+    return _exchange(
+        refs, _partition_by_hash, (nparts, keyfn),
+        _merge_groups, (keyfn, reducefn), nparts,
+    )
+
+
+def repartition_blocks(refs: List, nparts: int) -> List:
+    """Exact rebalance into ``nparts`` near-equal row-count blocks without
+    moving rows through the driver: per-block counts first, then each
+    output task slices only the input blocks it overlaps."""
+    if not refs:
+        return refs
+    count = ray_tpu.remote(num_cpus=1)(len)
+    lengths = ray_tpu.get([count.remote(r) for r in refs])
+    total = sum(lengths)
+    per = -(-total // nparts) if total else 0
+    # global row offsets of each input block
+    offsets = [0]
+    for ln in lengths:
+        offsets.append(offsets[-1] + ln)
+    slicer = ray_tpu.remote(num_cpus=1)(_slice_concat)
+    out = []
+    for p in range(nparts):
+        lo, hi = p * per, min((p + 1) * per, total)
+        if lo >= hi and total:
+            out.append(ray_tpu.put([]))
+            continue
+        ranges, picked = [], []
+        for i, r in enumerate(refs):
+            b0, b1 = offsets[i], offsets[i + 1]
+            s, e = max(lo, b0), min(hi, b1)
+            if s < e:
+                ranges.append((s - b0, e - b0))
+                picked.append(r)
+        out.append(slicer.remote(ranges, *picked))
+    return out
+
+
+def make_keyfn(key) -> Callable[[Any], Any]:
+    """None -> identity; str -> row[key]; callable -> itself."""
+    if key is None:
+        return lambda r: r
+    if isinstance(key, str):
+        return lambda r: r[key]
+    if callable(key):
+        return key
+    raise TypeError(f"sort/groupby key must be None, str or callable: {key!r}")

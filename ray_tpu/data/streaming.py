@@ -150,16 +150,28 @@ class StreamingExecutor:
         )
 
     def iter_output_refs(self) -> Iterator[Any]:
-        """Yield final-stage block refs as they materialize (streaming)."""
+        """Yield final-stage block refs in SOURCE-BLOCK ORDER as they
+        materialize (reference parity: dataset iteration order is
+        deterministic; completed out-of-order blocks wait for their turn —
+        the scheduling caps still bound how many can pile up)."""
         if not self.stages:
             for _idx, ref in self._outputs[-1]:
                 yield ref
             return
         last = len(self.stages) - 1
+        next_idx = 0
+        ready: Dict[int, Any] = {}
         while True:
             self._wire()
             while self._outputs[last]:
-                yield self._outputs[last].pop(0)[1]
+                idx, ref = self._outputs[last].pop(0)
+                ready[idx] = ref
+            while next_idx in ready:
+                yield ready.pop(next_idx)
+                next_idx += 1
             if self._done():
+                # any stragglers (should be none): emit in index order
+                for idx in sorted(ready):
+                    yield ready.pop(idx)
                 return
             self._pump()

@@ -58,3 +58,14 @@ def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Arr
     logits = _mlp_apply(params["pi"], obs)
     value = _mlp_apply(params["vf"], obs)[..., 0]
     return logits, value
+
+
+def init_q_network(rng: jax.Array, obs_dim: int, num_actions: int,
+                   hidden: Sequence[int] = (64, 64)) -> Dict:
+    """Q-network for DQN (reference rllib/algorithms/dqn catalog MLP)."""
+    return {"q": _mlp_params(rng, [obs_dim, *hidden], num_actions, 0.01)}
+
+
+def apply_q_network(params: Dict, obs: jax.Array) -> jax.Array:
+    """obs [B, obs_dim] -> Q-values [B, A]."""
+    return _mlp_apply(params["q"], obs)

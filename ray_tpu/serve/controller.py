@@ -57,30 +57,82 @@ class Replica:
 class ServeController:
     """Actor: owns deployment specs, reconciles replica sets, autoscales."""
 
-    DRAIN_GRACE_S = 5.0
+    ROUTER_REFRESH_S = 2.0   # routers re-pull the replica set within this
+    DRAIN_HARD_CAP_S = 60.0  # wedged-replica fallback
     ROUTER_TTL_S = 60.0
 
     def __init__(self):
+        import threading
+
         # name -> {"spec": {...}, "replicas": [handle], "version": int}
         self.deployments: Dict[str, Dict[str, Any]] = {}
         # router-reported ongoing-request counts: (deployment, router_id)
         self._load: Dict[str, Dict[str, Any]] = {}
         # replicas pulled from rotation but still finishing in-flight work:
-        # (handle, kill_after_ts) — killed lazily on later controller calls
+        # [handle, pulled_at_ts, sentinel_ref_or_None] — killed once the
+        # sentinel confirms the drain (background reaper below; an
+        # idle-cluster drain must not wait for the next controller call)
         self._draining: List = []
+        self._drain_lock = threading.Lock()
+
+        def reap_loop():
+            while True:
+                time.sleep(1.0)
+                try:
+                    self._reap_draining()
+                except Exception:
+                    pass  # cluster shutting down
+
+        threading.Thread(target=reap_loop, daemon=True).start()
 
     def _reap_draining(self):
+        """Real in-flight tracking (not a fixed grace window): once routers
+        have refreshed off the pulled replica (ROUTER_REFRESH_S), submit a
+        sentinel actor call — per-actor FIFO means the sentinel completes
+        only after every previously queued request has finished — and kill
+        when it resolves. A busy replica with a long request is never killed
+        mid-work (up to the hard cap); an idle one dies promptly. Parity:
+        reference replica drain via graceful_shutdown_wait_loop_s +
+        in-flight checks (replica.py prepare_for_shutdown)."""
         now = time.time()
         keep = []
-        for handle, deadline in self._draining:
-            if now >= deadline:
+        with self._drain_lock:
+            draining, self._draining = self._draining, []
+        for entry in draining:
+            handle, pulled_at, sentinel = entry
+            if sentinel is None:
+                if now - pulled_at >= self.ROUTER_REFRESH_S:
+                    try:
+                        entry[2] = handle.health.remote()
+                    except Exception:
+                        # submission failed (degraded cluster / dying
+                        # actor): keep the entry and retry next tick —
+                        # dropping it would leak an alive replica's
+                        # resources forever. The hard cap still bounds it.
+                        if now - pulled_at >= self.DRAIN_HARD_CAP_S:
+                            try:
+                                ray_tpu.kill(handle)
+                            except Exception:
+                                pass
+                            continue
+                keep.append(entry)
+                continue
+            drained = False
+            try:
+                ready, _ = ray_tpu.wait([sentinel], timeout=0,
+                                        fetch_local=False)
+                drained = bool(ready)
+            except Exception:
+                drained = True
+            if drained or now - pulled_at >= self.DRAIN_HARD_CAP_S:
                 try:
                     ray_tpu.kill(handle)
                 except Exception:
                     pass
             else:
-                keep.append((handle, deadline))
-        self._draining = keep
+                keep.append(entry)
+        with self._drain_lock:
+            self._draining.extend(keep)
 
     # -- deploy / reconcile --
 
@@ -124,10 +176,10 @@ class ServeController:
         )
 
     def _stop_replica(self, handle):
-        """Pull from rotation now; kill after a drain grace window so
-        in-flight requests can finish (routers stop routing to it within
-        their refresh interval)."""
-        self._draining.append((handle, time.time() + self.DRAIN_GRACE_S))
+        """Pull from rotation now; kill once in-flight work drains
+        (see _reap_draining)."""
+        with self._drain_lock:
+            self._draining.append([handle, time.time(), None])
 
     def _scale_to(self, name: str, n: int):
         dep = self.deployments[name]

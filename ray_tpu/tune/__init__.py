@@ -28,9 +28,14 @@ from ray_tpu.train.session import report  # noqa: F401 — tune.report parity
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -47,6 +52,8 @@ from ray_tpu.tune.tuner import (  # noqa: F401
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "FIFOScheduler", "ASHAScheduler", "PopulationBasedTraining",
+    "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearcher",
     "report",
 ]

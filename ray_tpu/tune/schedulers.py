@@ -143,3 +143,51 @@ class PopulationBasedTraining:
 
     def on_trial_complete(self, trial, result) -> None:
         self._last.pop(trial, None)
+
+
+class MedianStoppingRule:
+    """Stop a trial at iteration t if its best metric so far is worse than
+    the median of other trials' running averages at iteration >= t (parity:
+    reference ``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be max|min")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial -> list of scores per report (score = metric, sign-fixed)
+        self._history: Dict[Any, List[float]] = {}
+
+    def _score(self, result) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result) -> str:
+        it = int(result.get("training_iteration", len(
+            self._history.get(trial, [])) + 1))
+        h = self._history.setdefault(trial, [])
+        h.append(self._score(result))
+        if it < self.grace_period:
+            return CONTINUE
+        # running averages (up to iteration it) of OTHER trials that have at
+        # least grace_period reports — NOT `>= it` reports: concurrent
+        # trials advance in lockstep, so the first trial polled each round
+        # would never see an eligible comparator
+        others = [
+            sum(v[:it]) / min(it, len(v))
+            for t, v in self._history.items()
+            if t is not trial and len(v) >= self.grace_period
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if max(h) < median:
+            return STOP
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result) -> None:
+        pass

@@ -18,7 +18,7 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.session import TrainContext
 from ray_tpu.train.worker_group import _TrainWorker
 from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
-from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.search import BasicVariantGenerator
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -32,7 +32,10 @@ class TuneConfig:
     mode: str = "max"
     num_samples: int = 1
     max_concurrent_trials: int = 2
-    scheduler: Any = None  # FIFOScheduler | ASHAScheduler | PBT
+    scheduler: Any = None  # FIFOScheduler | ASHAScheduler | PBT | Median
+    # adaptive Searcher (TPESearcher / ConcurrencyLimiter); None = the
+    # basic grid x random variant generator over param_space
+    search_alg: Any = None
     seed: int = 0
 
     def __post_init__(self):
@@ -43,12 +46,18 @@ class TuneConfig:
 class Trial:
     _next = 0
 
-    def __init__(self, config: Dict[str, Any]):
-        Trial._next += 1
-        self.trial_id = f"trial_{Trial._next:05d}"
+    @classmethod
+    def next_id(cls) -> str:
+        cls._next += 1
+        return f"trial_{cls._next:05d}"
+
+    def __init__(self, config: Dict[str, Any],
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or Trial.next_id()
         self.config = config
         self.status = PENDING
         self.actor = None
+        self.poll_ref = None  # outstanding poll (one in flight per trial)
         self.last_result: Dict[str, Any] = {}
         self.iterations = 0
         self.error: Optional[str] = None
@@ -119,26 +128,34 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(
-            self.param_space, tc.num_samples, seed=tc.seed
-        )
-        trials = [Trial(cfg) for cfg in variants]
+        if tc.search_alg is not None:
+            searcher = tc.search_alg
+            max_trials = tc.num_samples
+        else:
+            searcher = BasicVariantGenerator(
+                self.param_space, tc.num_samples, seed=tc.seed
+            )
+            max_trials = None  # the generator itself exhausts
+        trials: List[Trial] = []
+        spawned = 0
         actor_cls = ray_tpu.remote(resources=dict(self.resources))(
             _TrainWorker
         )
 
         def start(trial: Trial):
+            # Non-blocking: the actor may stay PENDING until cluster
+            # resources free up (actor-FIFO guarantees start_training runs
+            # before any poll); blocking here would stall the ack pump for
+            # trials that are already running.
             trial.actor = actor_cls.remote()
+            trial.poll_ref = None
             ctx = TrainContext(
                 world_rank=0, world_size=1, experiment_name=trial.trial_id
             )
-            ray_tpu.get(
-                trial.actor.start_training.remote(
-                    self.trainable, trial.config, ctx,
-                    trial.start_checkpoint, True,  # sync_reports: the
-                    # scheduler must be able to stop between iterations
-                ),
-                timeout=120,
+            trial.actor.start_training.remote(
+                self.trainable, trial.config, ctx,
+                trial.start_checkpoint, True,  # sync_reports: the
+                # scheduler must be able to stop between iterations
             )
             trial.status = RUNNING
 
@@ -151,16 +168,52 @@ class Tuner:
                 trial.actor = None
 
         live: List[Trial] = []
-        queue = list(trials)
+        exhausted = False
         try:
-            while queue or live:
-                while queue and len(live) < tc.max_concurrent_trials:
-                    t = queue.pop(0)
+            while True:
+                while not exhausted and len(live) < tc.max_concurrent_trials:
+                    if max_trials is not None and spawned >= max_trials:
+                        exhausted = True
+                        break
+                    tid = Trial.next_id()
+                    cfg = searcher.suggest(tid)
+                    if cfg is None:
+                        # basic generator: done for good; a limiter: retry
+                        # once a slot frees
+                        if tc.search_alg is None:
+                            exhausted = True
+                        break
+                    t = Trial(cfg, trial_id=tid)
+                    trials.append(t)
+                    spawned += 1
                     start(t)
                     live.append(t)
-                refs = [t.actor.poll.remote(timeout=5.0) for t in live]
+                if not live:
+                    if exhausted or (
+                        max_trials is not None and spawned >= max_trials
+                    ):
+                        break
+                    if tc.search_alg is not None:
+                        # limiter returned None with nothing live — avoid
+                        # spinning forever on a wedged searcher
+                        break
+                    continue
+                for t in live:
+                    if t.poll_ref is None:
+                        t.poll_ref = t.actor.poll.remote(timeout=5.0)
+                ready, _ = ray_tpu.wait(
+                    [t.poll_ref for t in live],
+                    num_returns=len(live), timeout=8.0,
+                )
+                ready_set = set(ready)
                 still = []
-                for trial, ref in zip(live, refs):
+                for trial in live:
+                    if trial.poll_ref not in ready_set:
+                        # actor still pending placement (or a slow poll):
+                        # keep the outstanding ref, check again next round
+                        still.append(trial)
+                        continue
+                    ref, trial.poll_ref = trial.poll_ref, None
                     # per-trial fault isolation: a dead trial actor (OOM
                     # kill, node loss) becomes ERROR on that trial only —
                     # not a crashed experiment
@@ -171,6 +224,9 @@ class Tuner:
                         trial.error = f"trial actor died: {e!r}"
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_result, error=True
+                        )
                         continue
                     decision = CONTINUE
                     for ev in p["events"]:
@@ -191,6 +247,9 @@ class Tuner:
                         trial.status = TERMINATED
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_result
+                        )
                         continue
                     if decision == EXPLOIT:
                         donor = scheduler.exploit_target(
@@ -215,6 +274,10 @@ class Tuner:
                             trial.status = TERMINATED
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_result,
+                            error=p["error"] is not None,
+                        )
                         continue
                     still.append(trial)
                 live = still

@@ -214,3 +214,55 @@ def test_method_num_returns(rt):
     h = ray_tpu.get_actor("splitter2")
     x, y, z = h.three.remote()
     assert ray_tpu.get([x, y, z]) == [1, 2, 3]
+
+
+def test_pending_actor_waits_for_capacity(rt):
+    """An actor whose resources are temporarily unavailable must stay
+    PENDING (calls block) and get placed when capacity frees — not die
+    with a spurious ActorDiedError after a timeout (reference:
+    gcs_actor_scheduler.h:111, pending actors wait indefinitely)."""
+    import time as _time
+
+    @rt.remote(num_cpus=4)  # the whole node
+    class Hog:
+        def ping(self):
+            return "hog"
+
+    @rt.remote(num_cpus=1)
+    class Small:
+        def ping(self):
+            return "small"
+
+    hog = Hog.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == "hog"
+    small = Small.remote()  # cannot place while Hog holds all CPUs
+    ref = small.ping.remote()
+    ready, pending = rt.wait([ref], timeout=3)
+    assert pending, "small actor should still be pending"
+    rt.kill(hog)  # frees the CPUs -> small places and answers
+    assert rt.get(ref, timeout=60) == "small"
+
+
+def test_infeasible_actor_fails_with_cause():
+    """Resources no node can EVER satisfy -> the actor dies with an
+    infeasibility cause (after the join grace), not a hang."""
+    import pytest as _pytest
+
+    import ray_tpu
+
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=64 * 1024 * 1024,
+        system_config={"infeasible_task_grace_s": 3.0},
+    )
+    try:
+        @ray_tpu.remote(resources={"no_such_resource": 1})
+        class Nope:
+            def ping(self):
+                return 1
+
+        a = Nope.remote()
+        with _pytest.raises(Exception, match="infeasible|no alive node"):
+            ray_tpu.get(a.ping.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()

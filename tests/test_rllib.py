@@ -123,3 +123,86 @@ def test_impala_learns_cartpole_async(rt_rl):
         assert r["num_async_updates"] >= 2 * algo.config.num_workers
     finally:
         algo.stop()
+
+
+def test_dqn_replay_and_update_shapes():
+    """Learner-only smoke: replay buffer cycling + one jitted update."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.dqn import DQNConfig, _ReplayBuffer
+
+    buf = _ReplayBuffer(capacity=100, obs_dim=4)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        buf.add_batch({
+            "obs": rng.normal(size=(60, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, 60).astype(np.int32),
+            "rewards": rng.normal(size=60).astype(np.float32),
+            "next_obs": rng.normal(size=(60, 4)).astype(np.float32),
+            "terminals": (rng.random(60) < 0.1).astype(np.float32),
+        })
+    assert buf.size == 100  # capacity-clamped circular buffer
+    mb = buf.sample(rng, 32)
+    assert mb["obs"].shape == (32, 4)
+
+    # one in-process update step (no cluster)
+    import jax
+    import optax
+
+    from ray_tpu.rllib.dqn import DQN
+
+    algo = object.__new__(DQN)  # learner pieces only, no workers
+    algo.config = DQNConfig(train_batches=4, batch_size=16,
+                            target_update_freq=2)
+    algo.opt = optax.adam(1e-3)
+    from ray_tpu.rllib.models import init_q_network
+
+    algo.params = init_q_network(jax.random.key(0), 4, 2)
+    algo.target_params = jax.tree.map(lambda x: x, algo.params)
+    algo.opt_state = algo.opt.init(algo.params)
+    update = jax.jit(algo._make_update())
+    batches = {
+        k: jnp.asarray(np.stack([buf.sample(rng, 16)[k] for _ in range(4)]))
+        for k in mb
+    }
+    params, target, opt_state, step, loss = update(
+        algo.params, algo.target_params, algo.opt_state,
+        jnp.asarray(0, jnp.int32), batches,
+    )
+    assert int(step) == 4 and np.isfinite(float(loss))
+    # target synced at steps 2 and 4 (freq=2): equals the online params
+    chex_equal = jax.tree.map(
+        lambda a, b: bool(jnp.allclose(a, b)), params, target
+    )
+    assert all(jax.tree.leaves(chex_equal))
+
+
+def test_dqn_cartpole_learns(rt_rl):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = DQNConfig(
+        env="CartPole-v1",
+        num_workers=2,
+        rollout_len=256,
+        learning_starts=512,
+        train_batches=64,
+        batch_size=64,
+        lr=1e-3,
+        eps_decay_steps=4000,
+        target_update_freq=250,
+        seed=0,
+    ).build()
+    best = -np.inf
+    try:
+        for _ in range(70):
+            result = algo.train()
+            mean = result["episode_reward_mean"]
+            if np.isfinite(mean):
+                best = max(best, mean)
+            if best >= 150:
+                break
+        # DQN on CartPole: 150+ in ~1 min CI budget shows real learning
+        # (random play is ~20; PPO owns the 450 BASELINE bar)
+        assert best >= 150, f"DQN plateaued at {best}"
+    finally:
+        algo.stop()

@@ -254,3 +254,49 @@ def test_batched_deployment_survives_replica_death(rt_serve):
         except Exception:
             time.sleep(0.5)
     assert ok >= 4, "batched deployment never recovered"
+
+
+def test_drain_waits_for_inflight_requests(rt_serve):
+    """Scale-down/redeploy must not kill a replica mid-request: the
+    controller tracks in-flight work via a FIFO sentinel and kills only
+    once it drains (DESIGN known-deviation fix)."""
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, secs):
+            time.sleep(secs)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    # longer than the router refresh + old 5s grace window combined
+    fut = handle.remote(7.0)
+    time.sleep(0.5)  # ensure the request is in flight on the replica
+    # redeploy: the old replica is pulled from rotation and drained
+    serve.run(Slow.options(name="Slow").bind())
+    assert fut.result(timeout=120) == "done"
+
+
+def test_drain_kills_idle_replica_promptly(rt_serve):
+    """An idle drained replica must die well before the 60s hard cap."""
+
+    @serve.deployment(num_replicas=2)
+    class Idle:
+        def __call__(self, x):
+            return x
+
+    from ray_tpu.util.state import list_actors
+
+    handle = serve.run(Idle.bind())
+    assert handle.remote(1).result(timeout=120) == 1
+    serve.run(Idle.options(num_replicas=1).bind())
+    deadline = time.time() + 25
+    while time.time() < deadline:
+        # end state: the controller + exactly 1 replica (both old replicas
+        # drained and killed by the controller's background reaper)
+        if len(list_actors(state="ALIVE")) <= 2:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"drained idle replicas not killed in 25s: "
+        f"{[(a['name'], a['state']) for a in list_actors()]}"
+    )

@@ -138,3 +138,109 @@ def test_pbt_exploits_and_perturbs(rt_tune):
     assert pbt.num_exploits >= 1, "PBT never exploited"
     best = grid.get_best_result()
     assert best.metrics["score"] >= 10.0  # lr=1.0 territory
+
+
+def test_tpe_searcher_converges():
+    """Model-only test (no cluster): TPE should concentrate suggestions
+    near the optimum of a smooth 1-D objective after warmup."""
+    from ray_tpu.tune.search import TPESearcher
+
+    s = TPESearcher(
+        {"x": tune.uniform(0.0, 10.0)}, metric="score", mode="max",
+        n_initial=8, seed=3,
+    )
+    best = lambda x: -((x - 7.3) ** 2)  # noqa: E731
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        s.on_trial_complete(tid, {"score": best(cfg["x"])})
+    late = []
+    for i in range(10):
+        tid = f"probe{i}"
+        cfg = s.suggest(tid)
+        late.append(cfg["x"])
+        s.on_trial_complete(tid, {"score": best(cfg["x"])})
+    # most late suggestions land near the optimum
+    close = sum(1 for x in late if abs(x - 7.3) < 2.0)
+    assert close >= 6, late
+
+
+def test_tpe_categorical_and_randint():
+    from ray_tpu.tune.search import TPESearcher
+
+    s = TPESearcher(
+        {"c": tune.choice(["a", "b", "c"]), "n": tune.randint(1, 20)},
+        metric="loss", mode="min", n_initial=6, seed=0,
+    )
+    score = lambda cfg: (0.0 if cfg["c"] == "b" else 5.0) + abs(cfg["n"] - 10)  # noqa: E731
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        assert cfg["c"] in ("a", "b", "c") and 1 <= cfg["n"] < 20
+        s.on_trial_complete(f"t{i}", {"loss": score(cfg)})
+    late = [s.suggest(f"p{i}") for i in range(8)]
+    assert sum(1 for c in late if c["c"] == "b") >= 5, late
+
+
+def test_concurrency_limiter_caps_inflight():
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    base = BasicVariantGenerator({"x": tune.grid_search(list(range(6)))}, 1)
+    lim = ConcurrencyLimiter(base, max_concurrent=2)
+    a, b = lim.suggest("t1"), lim.suggest("t2")
+    assert a is not None and b is not None
+    assert lim.suggest("t3") is None  # at cap
+    lim.on_trial_complete("t1", {"m": 1.0})
+    assert lim.suggest("t3") is not None  # slot freed
+
+
+def test_tuner_with_tpe_searcher(rt_tune):
+    from ray_tpu.tune.search import TPESearcher
+
+    def objective(config):
+        from ray_tpu.train import session
+
+        session.report({"score": -(config["x"] - 3.0) ** 2})
+
+    res = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=10,
+            max_concurrent_trials=2,
+            search_alg=TPESearcher(
+                {"x": tune.uniform(0.0, 10.0)}, metric="score",
+                mode="max", n_initial=4, seed=1,
+            ),
+        ),
+    ).fit()
+    assert len(res) == 10
+    best = res.get_best_result()
+    assert abs(best.config["x"] - 3.0) < 3.0  # better than blind luck bound
+
+
+def test_median_stopping_rule(rt_tune):
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+
+    def objective(config):
+        from ray_tpu.train import session
+
+        for it in range(12):
+            session.report({"m": config["q"] * (it + 1)})
+
+    res = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 1.0, 1.1, 1.2])},
+        tune_config=tune.TuneConfig(
+            metric="m", mode="max", num_samples=1,
+            max_concurrent_trials=5,
+            scheduler=MedianStoppingRule(
+                metric="m", grace_period=3, min_samples_required=2
+            ),
+        ),
+    ).fit()
+    stopped = [r for r in res if r.metrics.get("training_iteration", 12) < 12]
+    finished = [r for r in res if r.metrics.get("training_iteration") == 12]
+    assert finished, "top trials should run to completion"
+    # the clearly-worse trials (q=0.1/0.2) get median-stopped
+    assert any(r.config["q"] < 0.5 for r in stopped), [
+        (r.config, r.metrics.get("training_iteration")) for r in res
+    ]
