@@ -453,7 +453,6 @@ class GcsServer:
         # wedged node, not a full one, and must surface instead of hanging
         # every caller forever. Reset whenever an attempt is healthy.
         error_deadline = None
-        BUSY_ERRORS = ("no worker available", "bundle not on this node / full")
         while rec.state in (PENDING, RESTARTING):
             node_id = self._pick_node_for(
                 spec.get("resources") or {}, strategy=strategy
@@ -506,10 +505,10 @@ class GcsServer:
                 await self._fail_actor(rec, reply.get("error", "creation failed"))
                 return
             err = reply.get("error", "")
-            if err in BUSY_ERRORS:
-                # busy node (lease parked then timed out): stay PENDING,
-                # retry forever; a healthy-but-full attempt clears the
-                # error bound
+            if reply.get("retryable"):
+                # busy node (structured flag from the raylet — lease parked
+                # then timed out / bundle full): stay PENDING, retry forever;
+                # a healthy-but-full attempt clears the error bound
                 error_deadline = None
             else:
                 if error_deadline is None:

@@ -910,7 +910,14 @@ class Raylet:
             if not self._can_acquire(
                 {"resources": resources, "strategy": strategy}
             ):
-                return {"ok": False, "error": "bundle not on this node / full"}
+                # retryable=True: a structured "busy, try again" signal — the
+                # GCS keys its retry-forever path off this flag, never off
+                # the error text (which is free to change).
+                return {
+                    "ok": False,
+                    "error": "bundle not on this node / full",
+                    "retryable": True,
+                }
         elif not self._feasible(resources):
             return {"ok": False, "error": "infeasible on this node"}
         fut = asyncio.get_running_loop().create_future()
@@ -922,7 +929,23 @@ class Raylet:
         try:
             grant = await asyncio.wait_for(fut, timeout=90)
         except asyncio.TimeoutError:
-            return {"ok": False, "error": "no worker available"}
+            # wait_for can cancel this coroutine in the same loop tick the
+            # grant landed: the done future then holds a live lease (worker +
+            # resources acquired) that must be released, not leaked.
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                stale = self.leases.pop(fut.result()["lease_id"], None)
+                if stale is not None:
+                    self._release_alloc(stale.alloc, stale.resources)
+                    lw = stale.worker
+                    lw.lease_id = None
+                    if lw.alive:
+                        self.idle.append(lw)
+                    self._pump_lease_queue()
+            return {
+                "ok": False,
+                "error": "no worker available",
+                "retryable": True,
+            }
         lease_id = grant["lease_id"]
 
         def release(kill_worker: bool):

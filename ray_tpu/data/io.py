@@ -175,11 +175,19 @@ def _write_block_csv(block: List[Dict], path: str) -> int:
     import csv
 
     if not block:
-        open(path, "w").close()
         return 0
-    cols = list(block[0].keys())
+    # Fieldnames are the union of keys across the whole block (first-seen
+    # order): rows with extra keys would otherwise raise in DictWriter and
+    # rows with missing keys get blanks via restval.
+    cols: List[str] = []
+    seen = set()
+    for row in block:
+        for k in row:
+            if k not in seen:
+                seen.add(k)
+                cols.append(k)
     with open(path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=cols)
+        w = csv.DictWriter(f, fieldnames=cols, restval="")
         w.writeheader()
         w.writerows(block)
     return len(block)
@@ -188,6 +196,8 @@ def _write_block_csv(block: List[Dict], path: str) -> int:
 def _write_block_json(block: List, path: str) -> int:
     import json
 
+    if not block:
+        return 0
     with open(path, "w") as f:
         for row in block:
             f.write(json.dumps(row) + "\n")
@@ -198,6 +208,8 @@ def _write_block_parquet(block: List[Dict], path: str) -> int:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    if not block:
+        return 0
     table = pa.Table.from_pylist(block)
     pq.write_table(table, path)
     return len(block)
@@ -222,5 +234,6 @@ def write_dataset(ds, path: str, fmt: str) -> List[str]:
         fname = os.path.join(path, f"{i:06d}.{ext}")
         pending.append(task.remote(ref, fname))
         files.append(fname)
-    ray_tpu.get(pending)  # propagate write errors
-    return files
+    counts = ray_tpu.get(pending)  # propagate write errors
+    # Empty blocks write nothing (writers return 0 without creating a file).
+    return [f for f, n in zip(files, counts) if n > 0]

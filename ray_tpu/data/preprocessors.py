@@ -240,12 +240,26 @@ class Concatenator(Preprocessor):
             import numpy as np
 
             out = []
+            # Inferred column order must be deterministic: a row's own dict
+            # insertion order would silently misalign feature vectors, so the
+            # inferred list is the sorted key union — content-based, hence
+            # identical across blocks that carry the same columns (a column
+            # entirely absent from one block still changes that block's
+            # width; pass ``columns=`` explicitly for ragged datasets).
+            # Rows missing a column get NaN, like the reference's
+            # pandas-based Concatenator.
+            take_all = _c
+            if take_all is None:
+                keys = set()
+                for r in block:
+                    keys.update(r)
+                take_all = sorted(k for k in keys if k not in _e and k != _o)
+            fill = float("nan")
             for r in block:
-                take = _c if _c is not None else [
-                    k for k in r if k not in _e and k != _o
-                ]
-                packed = np.asarray([r[k] for k in take], dtype=_d)
-                rest = {k: v for k, v in r.items() if k not in take}
+                packed = np.asarray(
+                    [r.get(k, fill) for k in take_all], dtype=_d
+                )
+                rest = {k: v for k, v in r.items() if k not in take_all}
                 rest[_o] = packed
                 out.append(rest)
             return out

@@ -73,23 +73,45 @@ class StreamingExecutor:
         else:
             self._outputs.append(list(enumerate(source_blocks)))
         self._peak_buffered = 0  # observability / tests
+        # Ordered-consumption state: blocks held for in-order yield count
+        # toward the final stage's buffer cap (they are materialized memory
+        # exactly like an output-queue entry), and the block the consumer
+        # needs next (_next_idx) bypasses the cap so a straggler can't
+        # deadlock a full reorder buffer.
+        self._ready: Dict[int, Any] = {}
+        self._next_idx = 0
 
     # -- scheduling core (parity: select_operator_to_run) --
+
+    def _buffered(self, i: int) -> int:
+        """Blocks this stage is responsible for in memory: finished outputs
+        + in-flight results + (for the last stage) the consumer-side reorder
+        buffer — the reorder buffer is real materialized memory and must
+        count, or one straggler lets the whole pipeline run ahead."""
+        n = len(self._outputs[i]) + len(self._inflight[i])
+        if i == len(self.stages) - 1:
+            n += len(self._ready)
+        return n
 
     def _schedulable(self, i: int) -> bool:
         if not self._inputs[i]:
             return False
         if len(self._inflight[i]) >= self.max_in_flight:
             return False
-        # backpressure: this stage's un-consumed output + in-flight must
-        # stay under the buffer cap
-        return (
-            len(self._outputs[i]) + len(self._inflight[i]) < self.max_buffered
-        )
+        if self._buffered(i) < self.max_buffered:
+            return True
+        # Head-of-line bypass: the block the ordered consumer is waiting on
+        # may always proceed, else a full reorder buffer deadlocks on a
+        # straggler that can no longer be scheduled.
+        return any(idx == self._next_idx for idx, _ in self._inputs[i])
 
     def _launch(self, i: int):
         stage = self.stages[i]
-        idx, block_ref = self._inputs[i].pop(0)
+        # Pop the lowest pipeline index first: the ordered consumer wants
+        # low indices, and FIFO arrival order is not index order once
+        # upstream tasks complete out of order.
+        k = min(range(len(self._inputs[i])), key=lambda j: self._inputs[i][j][0])
+        idx, block_ref = self._inputs[i].pop(k)
         task = ray_tpu.remote(num_cpus=stage.num_cpus)(_apply_stage_fn)
         out_ref = task.remote(stage.fn, stage.with_index, idx, block_ref)
         self._inflight[i][out_ref] = idx
@@ -117,8 +139,10 @@ class StreamingExecutor:
                     if r in infl:
                         self._outputs[i].append((infl.pop(r), r))
                         break
-        buffered = sum(len(q) for q in self._outputs) + sum(
-            len(f) for f in self._inflight
+        buffered = (
+            sum(len(q) for q in self._outputs)
+            + sum(len(f) for f in self._inflight)
+            + len(self._ready)
         )
         self._peak_buffered = max(self._peak_buffered, buffered)
         return bool(all_inflight or launched)
@@ -131,13 +155,25 @@ class StreamingExecutor:
         (a full stage j stalls stage j-1's scheduling via its output queue)."""
         for i in range(len(self.stages) - 1):
             j = i + 1
-            while self._outputs[i] and (
-                len(self._inputs[j])
-                + len(self._inflight[j])
-                + len(self._outputs[j])
-                < self.max_buffered
-            ):
-                self._inputs[j].append(self._outputs[i].pop(0))
+            while self._outputs[i]:
+                under_cap = (
+                    len(self._inputs[j]) + self._buffered(j) < self.max_buffered
+                )
+                # Head-of-line block moves regardless of cap (see
+                # _schedulable) so the ordered consumer always progresses.
+                has_next = any(
+                    idx == self._next_idx for idx, _ in self._outputs[i]
+                )
+                if not under_cap and not has_next:
+                    break
+                if under_cap:
+                    k = 0
+                else:
+                    k = next(
+                        k for k, (idx, _) in enumerate(self._outputs[i])
+                        if idx == self._next_idx
+                    )
+                self._inputs[j].append(self._outputs[i].pop(k))
 
     def _done(self) -> bool:
         # Mid-stage outputs still count as pending work: declaring done while
@@ -152,26 +188,27 @@ class StreamingExecutor:
     def iter_output_refs(self) -> Iterator[Any]:
         """Yield final-stage block refs in SOURCE-BLOCK ORDER as they
         materialize (reference parity: dataset iteration order is
-        deterministic; completed out-of-order blocks wait for their turn —
-        the scheduling caps still bound how many can pile up)."""
+        deterministic). Out-of-order blocks wait in ``self._ready``, which
+        counts toward the last stage's buffer cap (``_buffered``) so the
+        pipeline cannot run ahead behind one straggler; the head-of-line
+        block bypasses the cap so that straggler always completes."""
         if not self.stages:
             for _idx, ref in self._outputs[-1]:
                 yield ref
             return
         last = len(self.stages) - 1
-        next_idx = 0
-        ready: Dict[int, Any] = {}
         while True:
             self._wire()
             while self._outputs[last]:
                 idx, ref = self._outputs[last].pop(0)
-                ready[idx] = ref
-            while next_idx in ready:
-                yield ready.pop(next_idx)
-                next_idx += 1
+                self._ready[idx] = ref
+            while self._next_idx in self._ready:
+                yield self._ready.pop(self._next_idx)
+                self._next_idx += 1
             if self._done():
                 # any stragglers (should be none): emit in index order
-                for idx in sorted(ready):
-                    yield ready.pop(idx)
+                for idx in sorted(self._ready):
+                    yield self._ready.pop(idx)
+                self._next_idx = 0
                 return
             self._pump()

@@ -118,11 +118,18 @@ def generate_variants(
 
 
 class Searcher:
-    """suggest(trial_id) -> config dict (or None = exhausted for now);
+    """suggest(trial_id) -> config dict, or None = nothing to suggest *right
+    now* (back off and ask again); is_finished() -> True = the searcher will
+    never suggest again (exhausted). The two are distinct: an async searcher
+    may momentarily return None while more suggestions are coming, and the
+    tuner must not end the experiment on the first idle None.
     on_trial_complete(trial_id, result, error) feeds the model."""
 
     def suggest(self, trial_id: str):
         raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        return False
 
     def on_trial_complete(self, trial_id: str, result=None,
                           error: bool = False) -> None:
@@ -144,6 +151,9 @@ class BasicVariantGenerator(Searcher):
         self._i += 1
         return cfg
 
+    def is_finished(self):
+        return self._i >= len(self._variants)
+
 
 class ConcurrencyLimiter(Searcher):
     """Cap in-flight suggestions from the wrapped searcher (adaptive
@@ -162,6 +172,9 @@ class ConcurrencyLimiter(Searcher):
         if cfg is not None:
             self._live.add(trial_id)
         return cfg
+
+    def is_finished(self):
+        return self.searcher.is_finished()
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)

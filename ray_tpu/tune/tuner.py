@@ -169,20 +169,31 @@ class Tuner:
 
         live: List[Trial] = []
         exhausted = False
+        # A searcher returning None while not is_finished() means "nothing
+        # to suggest right now" — back off and re-poll, bounded by an idle
+        # deadline (reset on any suggestion or completion) so a wedged
+        # searcher — or one written to the old "None = exhausted" contract
+        # without is_finished() — can't hang fit() for long.
+        SEARCHER_IDLE_TIMEOUT_S = 15.0
+        idle_deadline = None
+        # The trial id offered to an idle searcher is reused until it
+        # accepts one, so back-off polling doesn't mint throwaway ids.
+        pending_tid = None
         try:
             while True:
                 while not exhausted and len(live) < tc.max_concurrent_trials:
                     if max_trials is not None and spawned >= max_trials:
                         exhausted = True
                         break
-                    tid = Trial.next_id()
+                    tid = pending_tid or Trial.next_id()
                     cfg = searcher.suggest(tid)
                     if cfg is None:
-                        # basic generator: done for good; a limiter: retry
-                        # once a slot frees
-                        if tc.search_alg is None:
+                        pending_tid = tid
+                        if searcher.is_finished():
                             exhausted = True
                         break
+                    pending_tid = None
+                    idle_deadline = None
                     t = Trial(cfg, trial_id=tid)
                     trials.append(t)
                     spawned += 1
@@ -193,10 +204,16 @@ class Tuner:
                         max_trials is not None and spawned >= max_trials
                     ):
                         break
-                    if tc.search_alg is not None:
-                        # limiter returned None with nothing live — avoid
-                        # spinning forever on a wedged searcher
+                    if searcher.is_finished():
                         break
+                    # idle searcher with nothing live: wait for it, bounded
+                    if idle_deadline is None:
+                        idle_deadline = time.monotonic() + (
+                            SEARCHER_IDLE_TIMEOUT_S
+                        )
+                    elif time.monotonic() > idle_deadline:
+                        break
+                    time.sleep(0.25)
                     continue
                 for t in live:
                     if t.poll_ref is None:
@@ -227,6 +244,7 @@ class Tuner:
                         searcher.on_trial_complete(
                             trial.trial_id, trial.last_result, error=True
                         )
+                        idle_deadline = None
                         continue
                     decision = CONTINUE
                     for ev in p["events"]:
@@ -250,6 +268,7 @@ class Tuner:
                         searcher.on_trial_complete(
                             trial.trial_id, trial.last_result
                         )
+                        idle_deadline = None
                         continue
                     if decision == EXPLOIT:
                         donor = scheduler.exploit_target(
@@ -278,6 +297,7 @@ class Tuner:
                             trial.trial_id, trial.last_result,
                             error=p["error"] is not None,
                         )
+                        idle_deadline = None
                         continue
                     still.append(trial)
                 live = still
