@@ -27,9 +27,13 @@ from ray_tpu.data.io import (  # noqa: F401
     read_numpy,
     read_parquet,
 )
+from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.streaming import ActorPoolStrategy  # noqa: F401
 
 __all__ = [
+    "ActorPoolStrategy",
+    "BlockAccessor",
     "Dataset",
     "DataIterator",
     "GroupedData",

@@ -2,9 +2,15 @@
 
 Parity: reference ``python/ray/data/dataset.py:170`` (Dataset over blocks
 with a lazy plan), ``read_api.py`` sources, ``iterator.py`` consumption and
-``streaming_split`` (``dataset.py:1125``). Blocks are plain Python lists of
-items living in the object store; transforms are remote tasks pipelined by
-the StreamingExecutor (streaming.py) with bounded buffering.
+``streaming_split`` (``dataset.py:1125``). Blocks are row lists OR columnar
+dicts of numpy arrays (block.py — the reference's Arrow/pandas block role):
+columnar blocks live once in shm and reach consumers as zero-copy views,
+so the trainer ingest path is array slicing, not per-row Python.
+
+All-to-all ops (shuffle/sort/groupby/repartition) are ExchangeStages
+executed inside the StreamingExecutor (shuffle.py) — they stream behind
+the upstream pipeline instead of materializing it (reference
+``push_based_shuffle.py`` role).
 """
 
 from __future__ import annotations
@@ -12,68 +18,82 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu
-from ray_tpu.data.streaming import Stage, StreamingExecutor
+from ray_tpu.data.block import VALUE_COL, BlockAccessor
+from ray_tpu.data.streaming import (
+    ActorPoolStrategy,
+    ExchangeStage,
+    Stage,
+    StreamingExecutor,
+)
 
 
-def batches_from_blocks(block_iter: Iterator[List], batch_size: int,
+def batches_from_blocks(block_iter: Iterator, batch_size: int,
                         batch_format: str = "rows") -> Iterator:
-    """Re-chunk a stream of blocks into fixed-size batches (tail partial).
-    Shared by Dataset.iter_batches and DataIterator.iter_batches.
+    """Re-chunk a stream of NATIVE blocks into fixed-size batches (tail
+    partial). Shared by Dataset.iter_batches and DataIterator.iter_batches.
 
-    batch_format: "rows" yields lists of items; "numpy" collates dict rows
-    into one dict of stacked arrays per batch (the device-put-ready form —
-    parity: reference iter_batches(batch_format="numpy")).
+    batch_format: "rows" yields lists of items; "numpy" yields the columnar
+    batch (dict of arrays, or a bare stacked array for tensor/scalar rows)
+    — the device-put-ready form (parity: reference
+    iter_batches(batch_format="numpy")). A batch cut from a single columnar
+    block is a zero-copy view over the object store.
     """
     # validate at CALL time (a generator would defer the error to first
     # iteration, far from the bad call site)
     if batch_format not in ("rows", "numpy"):
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
-    def emit(rows):
+    def assemble(pending: List, n: int):
+        taken, need = [], n
+        while need:
+            acc = BlockAccessor.for_block(pending[0])
+            avail = acc.num_rows()
+            if avail <= need:
+                taken.append(pending.pop(0))
+                need -= avail
+            else:
+                taken.append(acc.slice(0, need))      # views, no copy
+                pending[0] = acc.slice(need, avail)
+                need = 0
         if batch_format == "rows":
-            return rows
-        import numpy as np
-
-        if not rows or not isinstance(rows[0], dict):
-            return np.stack([np.asarray(r) for r in rows])
-        keys = set(rows[0])
-        for r in rows:
-            if set(r) != keys:
-                raise ValueError(
-                    "inconsistent batch schema for batch_format='numpy': "
-                    f"row keys {sorted(set(r))} vs {sorted(keys)}"
-                )
-        return {
-            k: np.stack([np.asarray(r[k]) for r in rows])
-            for k in rows[0]
-        }
+            out: List = []
+            for b in taken:
+                out.extend(BlockAccessor.for_block(b).to_rows())
+            return out
+        block = taken[0] if len(taken) == 1 else BlockAccessor.concat(taken)
+        return BlockAccessor.for_block(block).to_numpy_batch()
 
     def gen():
-        buf: List = []
+        pending: List = []
+        pending_rows = 0
         for block in block_iter:
-            buf.extend(block)
-            while len(buf) >= batch_size:
-                yield emit(buf[:batch_size])
-                buf = buf[batch_size:]
-        if buf:
-            yield emit(buf)
+            nrows = BlockAccessor.for_block(block).num_rows()
+            if not nrows:
+                continue
+            pending.append(block)
+            pending_rows += nrows
+            while pending_rows >= batch_size:
+                yield assemble(pending, batch_size)
+                pending_rows -= batch_size
+        if pending_rows:
+            yield assemble(pending, pending_rows)
 
     return gen()
 
 
 class Dataset:
-    """Lazy pipeline: source block refs + a chain of per-block stages.
+    """Lazy pipeline: source block refs + a chain of stages (1:1 map stages
+    and all-to-all ExchangeStages, both run by the StreamingExecutor).
 
     A Dataset may instead carry a ``source_factory`` — a thunk producing the
-    source refs on first consumption. Barrier ops (shuffle/sort/groupby/...)
-    use this so that *calling* them stays lazy (reference semantics: the
-    plan executes on iteration, not construction); the factory result is
-    cached, so repeated iteration does not re-execute the exchange.
+    source refs on first consumption (used by ``limit``/``union``, whose
+    shapes depend on materialized content); the factory result is cached.
     """
 
     def __init__(self, source_refs: Optional[List] = None,
-                 stages: Optional[List[Stage]] = None,
-                 source_factory: Optional[Callable[[], List]] = None):
+                 stages: Optional[List] = None,
+                 source_factory: Optional[Callable[[], List]] = None,
+                 plan_blocks: Optional[int] = None):
         if (source_refs is None) == (source_factory is None):
             raise ValueError(
                 "exactly one of source_refs / source_factory required"
@@ -81,6 +101,7 @@ class Dataset:
         self._source = source_refs
         self._source_factory = source_factory
         self._stages = stages or []
+        self._plan_blocks_hint = plan_blocks
 
     @property
     def _source_refs(self) -> List:
@@ -88,100 +109,132 @@ class Dataset:
             self._source = self._source_factory()
         return self._source
 
+    def _num_source_blocks(self) -> int:
+        if self._source is not None:
+            return len(self._source)
+        if self._plan_blocks_hint is not None:
+            return self._plan_blocks_hint
+        return len(self._source_refs)
+
+    def _plan_width(self) -> int:
+        """Output block count WITHOUT forcing a source_factory (exchange
+        construction must stay lazy): falls back to a default width when
+        the factory result isn't known yet."""
+        if self._source is not None:
+            n = len(self._source)
+        elif self._plan_blocks_hint is not None:
+            n = self._plan_blocks_hint
+        else:
+            n = 8  # unknown-width factory source: default exchange fan-out
+        for s in self._stages:
+            if isinstance(s, ExchangeStage):
+                n = s.nparts
+        return max(1, n)
+
     # ---------------- transforms (lazy) ----------------
 
     def map_batches(
         self,
-        fn: Callable[[List], List],
+        fn: Callable,
         *,
+        batch_format: Optional[str] = None,
+        compute: Optional[ActorPoolStrategy] = None,
         num_cpus: float = 1.0,
         name: Optional[str] = None,
     ) -> "Dataset":
-        """fn: block (list of items) -> block. (Reference map_batches with
-        batch == block; use .repartition-by-construction via parallelism.)"""
+        """Per-block transform. ``fn`` receives the block as:
+        ``batch_format=None`` — native form (row list or columnar dict);
+        ``"rows"`` — list of rows; ``"numpy"`` — columnar batch. It may
+        return rows, a dict of arrays (columnar), or an ndarray.
+
+        ``compute=ActorPoolStrategy(size=n)`` runs blocks on n stateful
+        actors (reference ActorPoolMapOperator); ``fn`` may then be a class,
+        constructed once per actor (model-loading UDFs)."""
         return Dataset(
             self._source_refs,
-            self._stages + [Stage(name or "map_batches", fn, num_cpus)],
+            self._stages + [Stage(name or "map_batches", fn, num_cpus,
+                                  batch_format=batch_format,
+                                  compute=compute)],
         )
 
     def map(self, fn: Callable[[Any], Any], **kw) -> "Dataset":
         return self.map_batches(
-            lambda block, _fn=fn: [_fn(x) for x in block],
-            name="map", **kw,
+            lambda rows, _fn=fn: [_fn(x) for x in rows],
+            name="map", batch_format="rows", **kw,
         )
 
     def filter(self, fn: Callable[[Any], bool], **kw) -> "Dataset":
         return self.map_batches(
-            lambda block, _fn=fn: [x for x in block if _fn(x)],
-            name="filter", **kw,
+            lambda rows, _fn=fn: [x for x in rows if _fn(x)],
+            name="filter", batch_format="rows", **kw,
         )
 
     def flat_map(self, fn: Callable[[Any], List[Any]], **kw) -> "Dataset":
         return self.map_batches(
-            lambda block, _fn=fn: [y for x in block for y in _fn(x)],
-            name="flat_map", **kw,
+            lambda rows, _fn=fn: [y for x in rows for y in _fn(x)],
+            name="flat_map", batch_format="rows", **kw,
         )
 
     def select_columns(self, cols: List[str], **kw) -> "Dataset":
-        return self.map_batches(
-            lambda block, _c=tuple(cols): [
-                {k: r[k] for k in _c} for r in block
-            ],
-            name="select_columns", **kw,
-        )
+        def select(block, _c=tuple(cols)):
+            if isinstance(block, dict):  # columnar: column subset, no copy
+                return {k: block[k] for k in _c}
+            return [{k: r[k] for k in _c} for r in block]
+
+        return self.map_batches(select, name="select_columns", **kw)
 
     def drop_columns(self, cols: List[str], **kw) -> "Dataset":
         drop = set(cols)
-        return self.map_batches(
-            lambda block, _d=drop: [
+
+        def dropper(block, _d=drop):
+            if isinstance(block, dict):
+                return {k: v for k, v in block.items() if k not in _d}
+            return [
                 {k: v for k, v in r.items() if k not in _d} for r in block
-            ],
-            name="drop_columns", **kw,
-        )
+            ]
+
+        return self.map_batches(dropper, name="drop_columns", **kw)
 
     def add_column(self, name: str, fn: Callable[[Any], Any],
                    **kw) -> "Dataset":
-        def add(block, _n=name, _fn=fn):
-            return [{**r, _n: _fn(r)} for r in block]
+        def add(rows, _n=name, _fn=fn):
+            return [{**r, _n: _fn(r)} for r in rows]
 
-        return self.map_batches(add, name="add_column", **kw)
+        return self.map_batches(add, name="add_column", batch_format="rows",
+                                **kw)
 
-    # ---------------- all-to-all ops (pipeline barriers) ----------------
+    # ---------------- all-to-all ops (in-executor exchanges) ----------------
 
     def _materialized_refs(self) -> List:
         return list(self._executor().iter_output_refs())
 
+    def _with_exchange(self, stage: ExchangeStage) -> "Dataset":
+        if self._source is not None:
+            return Dataset(self._source, self._stages + [stage])
+        return Dataset(source_factory=self._source_factory,
+                       stages=self._stages + [stage],
+                       plan_blocks=self._plan_blocks_hint)
+
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """EXACT global shuffle via two-phase map-partition / reduce-merge
-        (reference push_based_shuffle.py semantics; a barrier op — executes
-        lazily on first consumption)."""
-        from ray_tpu.data.shuffle import exact_shuffle
+        """EXACT global shuffle as a streaming exchange (reference
+        push_based_shuffle.py semantics)."""
+        from ray_tpu.data.shuffle import shuffle_stage
 
-        def build():
-            refs = self._materialized_refs()
-            return exact_shuffle(refs, max(1, len(refs)), seed)
-
-        return Dataset(source_factory=build)
+        return self._with_exchange(shuffle_stage(self._plan_width(), seed))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        from ray_tpu.data.shuffle import repartition_blocks
+        from ray_tpu.data.shuffle import repartition_stage
 
-        return Dataset(source_factory=lambda: repartition_blocks(
-            self._materialized_refs(), num_blocks
-        ))
+        return self._with_exchange(repartition_stage(num_blocks))
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         """Distributed sort (sampled range partition + per-partition sort);
-        output is globally ordered across blocks. Lazy barrier."""
-        from ray_tpu.data.shuffle import make_keyfn, sort_blocks
+        output is globally ordered across blocks."""
+        from ray_tpu.data.shuffle import sort_stage
 
-        def build():
-            refs = self._materialized_refs()
-            return sort_blocks(
-                refs, make_keyfn(key), descending, max(1, len(refs))
-            )
-
-        return Dataset(source_factory=build)
+        return self._with_exchange(
+            sort_stage(self._plan_width(), key, descending)
+        )
 
     def groupby(self, key) -> "GroupedData":
         return GroupedData(self, key)
@@ -193,7 +246,12 @@ class Dataset:
                 refs.extend(o._materialized_refs())
             return refs
 
-        return Dataset(source_factory=build)
+        return Dataset(
+            source_factory=build,
+            plan_blocks=self._plan_width() + sum(
+                o._plan_width() for o in others
+            ),
+        )
 
     def limit(self, n: int) -> "Dataset":
         """Truncate to the first n rows (lazy; on consumption, stops pulling
@@ -202,18 +260,21 @@ class Dataset:
         def build():
             out_refs, count = [], 0
             for ref in self._executor().iter_output_refs():
-                block = ray_tpu.get(ref)
-                if count + len(block) <= n:
+                acc = BlockAccessor.for_block(ray_tpu.get(ref))
+                if count + acc.num_rows() <= n:
                     out_refs.append(ref)
-                    count += len(block)
+                    count += acc.num_rows()
                 else:
-                    out_refs.append(ray_tpu.put(block[: n - count]))
+                    out_refs.append(
+                        ray_tpu.put(acc.slice(0, n - count))
+                    )
                     count = n
                 if count >= n:
                     break
             return out_refs or [ray_tpu.put([])]
 
-        return Dataset(source_factory=build)
+        return Dataset(source_factory=build,
+                       plan_blocks=self._plan_width())
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets of near-equal row counts (materializing)."""
@@ -230,8 +291,9 @@ class Dataset:
     # ---------------- aggregates ----------------
 
     def _column_values(self, on: Optional[str]) -> Iterator[Any]:
-        for row in self.iter_rows():
-            yield row[on] if on is not None else row
+        for block in self.iter_native_blocks():
+            vals = BlockAccessor.for_block(block).key_values(on)
+            yield from vals
 
     def sum(self, on: Optional[str] = None):
         return sum(self._column_values(on))
@@ -302,18 +364,24 @@ class Dataset:
     def _executor(self, **kw) -> StreamingExecutor:
         return StreamingExecutor(self._stages, self._source_refs, **kw)
 
-    def iter_blocks(self, **kw) -> Iterator[List]:
+    def iter_native_blocks(self, **kw) -> Iterator:
+        """Blocks in their stored form (row list or columnar dict)."""
         for ref in self._executor(**kw).iter_output_refs():
             yield ray_tpu.get(ref)
 
+    def iter_blocks(self, **kw) -> Iterator[List]:
+        """Blocks as ROW LISTS (legacy/compat view)."""
+        for block in self.iter_native_blocks(**kw):
+            yield BlockAccessor.for_block(block).to_rows()
+
     def iter_rows(self, **kw) -> Iterator[Any]:
-        for block in self.iter_blocks(**kw):
-            yield from block
+        for block in self.iter_native_blocks(**kw):
+            yield from BlockAccessor.for_block(block).iter_rows()
 
     def iter_batches(self, batch_size: int = 256,
                      batch_format: str = "rows", **kw) -> Iterator:
         return batches_from_blocks(
-            self.iter_blocks(**kw), batch_size, batch_format
+            self.iter_native_blocks(**kw), batch_size, batch_format
         )
 
     def take(self, n: int = 20) -> List:
@@ -328,7 +396,10 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        return sum(len(b) for b in self.iter_blocks())
+        return sum(
+            BlockAccessor.for_block(b).num_rows()
+            for b in self.iter_native_blocks()
+        )
 
     def materialize(self) -> "Dataset":
         """Execute the plan now; the result is a stage-free Dataset."""
@@ -336,7 +407,14 @@ class Dataset:
         return Dataset(refs, [])
 
     def num_blocks(self) -> int:
-        return len(self._source_refs)
+        """Output block count of the (unexecuted) plan: map stages are 1:1,
+        exchanges emit their partition count. May force a pending
+        source_factory (limit/union) to learn its width."""
+        n = self._num_source_blocks()
+        for s in self._stages:
+            if isinstance(s, ExchangeStage):
+                n = s.nparts
+        return n
 
     # ---------------- split ----------------
 
@@ -355,38 +433,33 @@ class Dataset:
 
     def __repr__(self):
         names = " -> ".join(s.name for s in self._stages) or "source"
-        return f"Dataset({len(self._source_refs)} blocks: {names})"
+        return f"Dataset({self._num_source_blocks()} blocks: {names})"
 
 
 class GroupedData:
     """``ds.groupby(key)`` result (reference GroupedData, grouped_data.py):
-    hash-partitioned exact aggregation — each key reduced exactly once."""
+    hash-partitioned exact aggregation — each key reduced exactly once,
+    streaming behind the upstream pipeline."""
 
     def __init__(self, ds: Dataset, key):
         self._ds = ds
         self._key = key
 
-    def _reduce(self, name: str,
-                reducefn: Callable[[Any, List], Any]) -> Dataset:
-        from ray_tpu.data.shuffle import groupby_reduce, make_keyfn
+    def _reduce(self, reducefn: Callable[[Any, List], Any]) -> Dataset:
+        from ray_tpu.data.shuffle import groupby_stage
 
-        def build():
-            refs = self._ds._materialized_refs()
-            return groupby_reduce(refs, make_keyfn(self._key), reducefn,
-                                  max(1, len(refs)))
-
-        return Dataset(source_factory=build)
+        return self._ds._with_exchange(
+            groupby_stage(self._ds._plan_width(), self._key, reducefn)
+        )
 
     def count(self) -> Dataset:
-        return self._reduce(
-            "count", lambda k, rows: {"key": k, "count": len(rows)}
-        )
+        return self._reduce(lambda k, rows: {"key": k, "count": len(rows)})
 
     def _col_agg(self, name: str, on: str, agg) -> Dataset:
         def red(k, rows, _on=on, _agg=agg, _n=name):
             return {"key": k, f"{_n}({_on})": _agg([r[_on] for r in rows])}
 
-        return self._reduce(name, red)
+        return self._reduce(red)
 
     def sum(self, on: str) -> Dataset:
         return self._col_agg("sum", on, sum)
@@ -402,7 +475,7 @@ class GroupedData:
 
     def map_groups(self, fn: Callable[[List], Any]) -> Dataset:
         """fn(group_rows) -> one output item per group."""
-        return self._reduce("map_groups", lambda k, rows, _f=fn: _f(rows))
+        return self._reduce(lambda k, rows, _f=fn: _f(rows))
 
 
 # ---------------- sources (parity: read_api.py) ----------------
@@ -421,7 +494,10 @@ def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
 
 
 def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001 — parity
+    """Columnar tensor blocks of int64 (reference ``ray.data.range``)."""
     import builtins
+
+    import numpy as np
 
     per = -(-n // max(1, parallelism))
     descriptors = [
@@ -431,10 +507,8 @@ def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001 — parity
     refs = [ray_tpu.put([d]) for d in descriptors]
 
     def expand(block):
-        out = []
-        for start, end in block:
-            out.extend(builtins.range(start, end))
-        return out
+        (start, end), = block
+        return {VALUE_COL: np.arange(start, end, dtype=np.int64)}
 
     return Dataset(refs, [Stage("range", expand)])
 

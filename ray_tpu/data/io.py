@@ -67,14 +67,18 @@ def _load_json(paths: List[str]) -> List[Any]:
     return out
 
 
-def _load_parquet(paths: List[str],
-                  columns: Optional[List[str]]) -> List[Dict[str, Any]]:
+def _load_parquet(paths: List[str], columns: Optional[List[str]]):
+    """Columnar blocks straight from Arrow (zero per-row Python): each
+    column becomes a numpy array (strings degrade to object arrays)."""
     import pyarrow.parquet as pq
 
-    out: List[Dict[str, Any]] = []
-    for path in paths:
-        out.extend(pq.read_table(path, columns=columns).to_pylist())
-    return out
+    tables = [pq.read_table(path, columns=columns) for path in paths]
+    if not tables:
+        return []
+    import pyarrow as pa
+
+    table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    return _table_to_block(table)
 
 
 # ---------------- read API ----------------
@@ -123,14 +127,18 @@ def read_parquet(paths, parallelism: int = 8,
 
 
 def read_numpy(paths, parallelism: int = 8):
-    """Each .npy file's rows (axis 0) become items."""
+    """Each .npy file's rows (axis 0) become items (one columnar tensor
+    block per task — zero-copy through the object store)."""
     def load(block):
         import numpy as np
 
-        out: List[Any] = []
-        for path in block:
-            out.extend(np.load(path))
-        return out
+        from ray_tpu.data.block import VALUE_COL
+
+        arrs = [np.load(path) for path in block]
+        if not arrs:
+            return []
+        return {VALUE_COL: np.concatenate(arrs) if len(arrs) > 1
+                else arrs[0]}
 
     return _reader_dataset(paths, parallelism, "read_numpy", load)
 
@@ -138,42 +146,67 @@ def read_numpy(paths, parallelism: int = 8):
 # ---------------- in-memory interop ----------------
 
 
+def _df_to_block(df):
+    return {str(c): df[c].to_numpy() for c in df.columns}
+
+
 def from_pandas(dfs, parallelism: int = 8):
-    """DataFrame(s) -> Dataset of dict rows (one block per input frame when
-    multiple frames are given; a single frame is row-split)."""
-    from ray_tpu.data.dataset import Dataset, from_items
+    """DataFrame(s) -> Dataset of columnar blocks (one per input frame;
+    a single frame is row-split into ~parallelism blocks)."""
+    from ray_tpu.data.dataset import Dataset
 
     if not isinstance(dfs, (list, tuple)):
-        return from_items(dfs.to_dict("records"), parallelism=parallelism)
-    refs = [ray_tpu.put(df.to_dict("records")) for df in dfs]
+        n = len(dfs)
+        nblocks = max(1, min(parallelism, n or 1))
+        per = -(-n // nblocks) if n else 1
+        dfs = [dfs.iloc[i: i + per] for i in range(0, n, per)] or [dfs]
+    refs = [ray_tpu.put(_df_to_block(df)) for df in dfs]
     return Dataset(refs or [ray_tpu.put([])])
 
 
 def from_numpy(arrays, parallelism: int = 8):
-    """ndarray(s) -> Dataset of rows along axis 0."""
-    from ray_tpu.data.dataset import Dataset, from_items
+    """ndarray(s) -> Dataset of rows along axis 0, stored as columnar
+    tensor blocks (zero-copy through the object store)."""
+    from ray_tpu.data.block import VALUE_COL
+    from ray_tpu.data.dataset import Dataset
 
     if not isinstance(arrays, (list, tuple)):
-        return from_items(list(arrays), parallelism=parallelism)
-    refs = [ray_tpu.put(list(a)) for a in arrays]
+        n = len(arrays)
+        nblocks = max(1, min(parallelism, n or 1))
+        per = -(-n // nblocks) if n else 1
+        arrays = [arrays[i: i + per] for i in range(0, n, per)] or [arrays]
+    refs = [ray_tpu.put({VALUE_COL: a}) for a in arrays]
     return Dataset(refs or [ray_tpu.put([])])
 
 
+def _table_to_block(table):
+    import numpy as np
+
+    return {
+        name: np.asarray(col.to_numpy(zero_copy_only=False))
+        for name, col in zip(table.column_names, table.columns)
+    }
+
+
 def from_arrow(tables, parallelism: int = 8):
-    from ray_tpu.data.dataset import Dataset, from_items
+    """Arrow table(s) -> Dataset of columnar blocks."""
+    from ray_tpu.data.dataset import Dataset
 
     if not isinstance(tables, (list, tuple)):
-        return from_items(tables.to_pylist(), parallelism=parallelism)
-    refs = [ray_tpu.put(t.to_pylist()) for t in tables]
+        tables = [tables]
+    refs = [ray_tpu.put(_table_to_block(t)) for t in tables]
     return Dataset(refs or [ray_tpu.put([])])
 
 
 # ---------------- writers (task bodies; one file per block) ----------------
 
 
-def _write_block_csv(block: List[Dict], path: str) -> int:
+def _write_block_csv(block, path: str) -> int:
     import csv
 
+    from ray_tpu.data.block import BlockAccessor
+
+    block = BlockAccessor.for_block(block).to_rows()
     if not block:
         return 0
     # Fieldnames are the union of keys across the whole block (first-seen
@@ -193,26 +226,45 @@ def _write_block_csv(block: List[Dict], path: str) -> int:
     return len(block)
 
 
-def _write_block_json(block: List, path: str) -> int:
+def _json_default(o):
+    import numpy as np
+
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+def _write_block_json(block, path: str) -> int:
     import json
 
+    from ray_tpu.data.block import BlockAccessor
+
+    block = BlockAccessor.for_block(block).to_rows()
     if not block:
         return 0
     with open(path, "w") as f:
         for row in block:
-            f.write(json.dumps(row) + "\n")
+            f.write(json.dumps(row, default=_json_default) + "\n")
     return len(block)
 
 
-def _write_block_parquet(block: List[Dict], path: str) -> int:
+def _write_block_parquet(block, path: str) -> int:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    if not block:
+    from ray_tpu.data.block import BlockAccessor, is_columnar
+
+    acc = BlockAccessor.for_block(block)
+    if not acc.num_rows():
         return 0
-    table = pa.Table.from_pylist(block)
+    if is_columnar(block):  # column arrays go straight into Arrow
+        table = pa.table({k: pa.array(v) for k, v in block.items()})
+    else:
+        table = pa.Table.from_pylist(block)
     pq.write_table(table, path)
-    return len(block)
+    return acc.num_rows()
 
 
 _WRITERS = {

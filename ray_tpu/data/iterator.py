@@ -74,7 +74,8 @@ class DataIterator:
         self._split = split
         self._timeout = timeout
 
-    def iter_blocks(self) -> Iterator[List]:
+    def iter_native_blocks(self) -> Iterator:
+        """Blocks in stored form (row list or columnar dict)."""
         import time as _time
 
         while True:
@@ -89,6 +90,12 @@ class DataIterator:
                 continue
             yield ray_tpu.get(reply[0], timeout=self._timeout)
 
+    def iter_blocks(self) -> Iterator[List]:
+        from ray_tpu.data.block import BlockAccessor
+
+        for block in self.iter_native_blocks():
+            yield BlockAccessor.for_block(block).to_rows()
+
     def stop(self):
         """Kill the shared coordinator actor (call once per split group,
         e.g. when a trainer attempt ends)."""
@@ -98,13 +105,15 @@ class DataIterator:
             pass
 
     def iter_rows(self) -> Iterator[Any]:
-        for block in self.iter_blocks():
-            yield from block
+        from ray_tpu.data.block import BlockAccessor
+
+        for block in self.iter_native_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
 
     def iter_batches(self, batch_size: int = 256,
                      batch_format: str = "rows") -> Iterator:
         from ray_tpu.data.dataset import batches_from_blocks
 
         return batches_from_blocks(
-            self.iter_blocks(), batch_size, batch_format
+            self.iter_native_blocks(), batch_size, batch_format
         )

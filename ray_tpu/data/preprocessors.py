@@ -29,7 +29,9 @@ class Preprocessor:
 
     def transform(self, ds):
         self._check_fitted()
-        return ds.map_batches(self._make_block_fn(),
+        # block fns are row-oriented: request the rows view so they work on
+        # columnar blocks too
+        return ds.map_batches(self._make_block_fn(), batch_format="rows",
                               name=type(self).__name__)
 
     def transform_batch(self, rows: List[Dict[str, Any]]) -> List[Dict]:
@@ -74,7 +76,8 @@ def _column_stats(ds, cols: List[str]) -> Dict[str, Dict[str, float]]:
             "min": float("inf"), "max": float("-inf")}
         for c in cols
     }
-    for block in ds.map_batches(stats, name="fit_stats").iter_blocks():
+    for block in ds.map_batches(stats, batch_format="rows",
+                                name="fit_stats").iter_blocks():
         for part in block:
             for c, s in part.items():
                 m = merged[c]
@@ -160,7 +163,8 @@ class LabelEncoder(Preprocessor):
             return [sorted({r[_c] for r in block})]
 
         seen = set()
-        for block in ds.map_batches(uniques, name="fit_labels").iter_blocks():
+        for block in ds.map_batches(uniques, batch_format="rows",
+                                    name="fit_labels").iter_blocks():
             for part in block:
                 seen.update(part)
         self.mapping_ = {v: i for i, v in enumerate(sorted(seen))}
@@ -193,7 +197,8 @@ class OneHotEncoder(Preprocessor):
             return [{c: sorted({r[c] for r in block}) for c in _cols}]
 
         seen: Dict[str, set] = {c: set() for c in cols}
-        for block in ds.map_batches(uniques, name="fit_onehot").iter_blocks():
+        for block in ds.map_batches(uniques, batch_format="rows",
+                                    name="fit_onehot").iter_blocks():
             for part in block:
                 for c, vals in part.items():
                     seen[c].update(vals)

@@ -2,50 +2,136 @@
 
 Parity: reference ``python/ray/data/_internal/planner/exchange/`` and
 ``push_based_shuffle.py`` / ``sort.py`` — the two-phase map-partition /
-reduce-merge exchange. These are pipeline *barriers* in the reference too
-(an all-to-all op consumes its whole input before emitting); here the
-upstream plan is executed (streaming, so driver memory stays bounded —
-blocks land in the object store, not on the driver), then a map stage
-partitions every block into P parts (``num_returns=P`` tasks) and a reduce
-stage merges part ``p`` of every map output. Only refs flow through the
-driver; rows move worker-to-worker through the object plane.
+reduce-merge exchange. Unlike round 2 (driver-side ``_materialized_refs``
+barriers), these now build :class:`~ray_tpu.data.streaming.ExchangeStage`
+operators that run INSIDE the streaming executor: prepare/partition tasks
+chase the upstream pipeline block-by-block, merges launch in output order
+under the downstream buffer cap, and partition refs are dropped as their
+merge completes — so a dataset larger than the object store shuffles by
+spilling partition outputs, never by pinning everything at once.
+
+Blocks are row lists or columnar dicts (block.py); the columnar paths are
+vectorized (``np.searchsorted`` range partition, ``argsort`` merges,
+permutation shuffles) — no per-row Python on array data.
 """
 
 from __future__ import annotations
 
 import random as _random
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
-import ray_tpu
+import numpy as np
 
-
-# ---------------- task bodies (run on workers) ----------------
-
-
-def _rets(parts: List[List]):
-    """num_returns=N tasks return an N-tuple; num_returns=1 tasks return
-    the single value itself (not a 1-tuple)."""
-    return parts[0] if len(parts) == 1 else tuple(parts)
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.streaming import ExchangeStage
 
 
-def _partition_random(block: List, nparts: int, seed: int):
-    rng = _random.Random(seed)
-    parts: List[List] = [[] for _ in range(nparts)]
-    for row in block:
-        parts[rng.randrange(nparts)].append(row)
-    return _rets(parts)
+def make_keyfn(key) -> Callable[[Any], Any]:
+    """None -> identity; str -> row[key]; callable -> itself."""
+    if key is None:
+        return lambda r: r
+    if isinstance(key, str):
+        return lambda r: r[key]
+    if callable(key):
+        return key
+    raise TypeError(f"sort/groupby key must be None, str or callable: {key!r}")
 
 
-def _partition_by_key(block: List, boundaries: List, keyfn) -> tuple:
-    """Range partition: part i gets rows with boundaries[i-1] <= key <
-    boundaries[i] (P = len(boundaries)+1 parts)."""
-    import bisect
+def _take_parts(acc: BlockAccessor, assignment: np.ndarray,
+                nparts: int) -> List:
+    return [acc.take(np.nonzero(assignment == p)[0]) for p in range(nparts)]
 
-    nparts = len(boundaries) + 1
-    parts: List[List] = [[] for _ in range(nparts)]
-    for row in block:
-        parts[bisect.bisect_right(boundaries, keyfn(row))].append(row)
-    return _rets(parts)
+
+# ---------------- random shuffle ----------------
+
+
+def shuffle_stage(nparts: int, seed: Optional[int]) -> ExchangeStage:
+    base = seed if seed is not None else _random.randrange(1 << 30)
+
+    def make_partition(_metas):
+        def partition(block, idx, _n=nparts, _s=base):
+            acc = BlockAccessor.for_block(block)
+            rng = np.random.default_rng(_s * 1000003 + idx)
+            assignment = rng.integers(0, _n, size=acc.num_rows())
+            return _take_parts(acc, assignment, _n)
+
+        return partition
+
+    def merge(p, *parts, _s=base):
+        block = BlockAccessor.concat(parts)
+        acc = BlockAccessor.for_block(block)
+        perm = np.random.default_rng(_s * 7 + p).permutation(acc.num_rows())
+        return acc.take(perm)
+
+    return ExchangeStage("random_shuffle", nparts, make_partition, merge)
+
+
+# ---------------- sort ----------------
+
+
+def _sample_keys_body(key, k: int = 32):
+    def sample(block, _key=key, _k=k):
+        acc = BlockAccessor.for_block(block)
+        vals = acc.key_values(_key)
+        n = len(vals)
+        if n <= _k:
+            return list(vals)
+        idx = np.random.default_rng(1299721 + n).integers(0, n, size=_k)
+        return [vals[int(i)] for i in idx]
+
+    return sample
+
+
+def sort_stage(nparts: int, key, descending: bool) -> ExchangeStage:
+    def make_partition(metas: Dict[int, List]):
+        keys = sorted(k for s in metas.values() for k in s)
+        if keys:
+            boundaries = [
+                keys[min(len(keys) - 1, (len(keys) * (i + 1)) // nparts)]
+                for i in range(nparts - 1)
+            ]
+        else:
+            boundaries = []
+
+        def partition(block, _idx, _b=boundaries, _key=key, _n=nparts,
+                      _desc=descending):
+            acc = BlockAccessor.for_block(block)
+            vals = acc.key_values(_key)
+            if not _b:
+                a = np.zeros(len(vals), dtype=np.intp)
+            elif isinstance(vals, np.ndarray):
+                a = np.searchsorted(np.asarray(_b), vals, side="right")
+            else:
+                import bisect
+
+                a = np.asarray(
+                    [bisect.bisect_right(_b, v) for v in vals],
+                    dtype=np.intp,
+                ) if len(vals) else np.zeros(0, dtype=np.intp)
+            if _desc:  # part 0 holds the LARGEST keys
+                a = (_n - 1) - a
+            return _take_parts(acc, a, _n)
+
+        return partition
+
+    def merge(p, *parts, _key=key, _desc=descending):
+        block = BlockAccessor.concat(parts)
+        acc = BlockAccessor.for_block(block)
+        vals = acc.key_values(_key)
+        if isinstance(vals, np.ndarray):
+            order = np.argsort(vals, kind="stable")
+            if _desc:
+                order = order[::-1]
+            return acc.take(order)
+        rows = acc.to_rows()
+        rows.sort(key=make_keyfn(_key), reverse=_desc)
+        return rows
+
+    return ExchangeStage("sort", nparts, make_partition, merge,
+                         prepare_fn=_sample_keys_body(key))
+
+
+# ---------------- groupby ----------------
 
 
 def _stable_hash(v) -> int:
@@ -63,167 +149,116 @@ def _stable_hash(v) -> int:
         for item in v:
             h = (h * 1099511628211 ^ _stable_hash(item)) & ((1 << 64) - 1)
         return h
+    if isinstance(v, np.generic):
+        v = v.item()
     if isinstance(v, (int, float, bool)) or v is None:
         return hash(v)  # numeric hash is not randomized
     return zlib.crc32(repr(v).encode())
 
 
-def _partition_by_hash(block: List, nparts: int, keyfn):
-    parts: List[List] = [[] for _ in range(nparts)]
-    for row in block:
-        h = _stable_hash(keyfn(row))
-        parts[(h ^ (h >> 16)) % nparts].append(row)
-    return _rets(parts)
+def groupby_stage(nparts: int, key,
+                  reducefn: Callable[[Any, List], Any]) -> ExchangeStage:
+    def make_partition(_metas):
+        def partition(block, _idx, _key=key, _n=nparts):
+            acc = BlockAccessor.for_block(block)
+            vals = acc.key_values(_key)
+            a = np.asarray(
+                [(h ^ (h >> 16)) % _n
+                 for h in (_stable_hash(v) for v in vals)],
+                dtype=np.intp,
+            ) if len(vals) else np.zeros(0, dtype=np.intp)
+            return _take_parts(acc, a, _n)
+
+        return partition
+
+    def merge(_p, *parts, _key=key, _red=reducefn):
+        """Group rows by key within this partition (hash partitioning
+        guarantees a key lives in exactly one partition), reduce each."""
+        keyfn = make_keyfn(_key)
+        groups: dict = {}
+        for part in parts:
+            for row in BlockAccessor.for_block(part).iter_rows():
+                k = keyfn(row)
+                if isinstance(k, np.generic):
+                    k = k.item()
+                groups.setdefault(k, []).append(row)
+        try:
+            items = sorted(groups.items())
+        except TypeError:  # unorderable key mix — keep insertion order
+            items = list(groups.items())
+        return [_red(k, rows) for k, rows in items]
+
+    return ExchangeStage("groupby", nparts, make_partition, merge)
 
 
-def _merge_shuffle(seed: int, *parts) -> List:
-    out: List = []
-    for p in parts:
-        out.extend(p)
-    _random.Random(seed).shuffle(out)
-    return out
+# ---------------- repartition ----------------
 
 
-def _merge_sort(keyfn, descending: bool, *parts) -> List:
-    out: List = []
-    for p in parts:
-        out.extend(p)
-    out.sort(key=keyfn, reverse=descending)
-    return out
+def _count_rows(block) -> int:
+    return BlockAccessor.for_block(block).num_rows()
 
 
-def _merge_groups(keyfn, reducefn, *parts) -> List:
-    """Group rows by key within this partition (hash partitioning guarantees
-    a key lives in exactly one partition) and reduce each group."""
-    groups: dict = {}
-    for p in parts:
-        for row in p:
-            groups.setdefault(keyfn(row), []).append(row)
-    try:
-        items = sorted(groups.items())
-    except TypeError:  # unorderable key mix — keep insertion order
-        items = list(groups.items())
-    return [reducefn(k, rows) for k, rows in items]
+def repartition_stage(nparts: int) -> ExchangeStage:
+    def make_partition(metas: Dict[int, int]):
+        idxs = sorted(metas)
+        offsets = {}
+        pos = 0
+        for i in idxs:
+            offsets[i] = pos
+            pos += metas[i]
+        total = pos
+        per = -(-total // nparts) if total else 0
+
+        def partition(block, idx, _off=offsets, _per=per, _total=total,
+                      _n=nparts):
+            acc = BlockAccessor.for_block(block)
+            b0 = _off[idx]
+            b1 = b0 + acc.num_rows()
+            parts = []
+            for p in range(_n):
+                lo = p * _per
+                hi = min((p + 1) * _per, _total)
+                s, e = max(lo, b0), min(hi, b1)
+                parts.append(
+                    acc.slice(s - b0, e - b0) if s < e else acc.slice(0, 0)
+                )
+            return parts
+
+        return partition
+
+    def merge(_p, *parts):
+        return BlockAccessor.concat(parts)
+
+    return ExchangeStage("repartition", nparts, make_partition, merge,
+                         prepare_fn=_count_rows)
 
 
-def _sample_keys(block: List, k: int, seed: int, keyfn) -> List:
-    rng = _random.Random(seed)
-    n = len(block)
-    if n <= k:
-        return [keyfn(r) for r in block]
-    return [keyfn(block[rng.randrange(n)]) for _ in range(k)]
-
-
-def _slice_concat(ranges, *blocks) -> List:
-    """ranges[i] = (start, end) row slice to take from blocks[i]."""
-    out: List = []
-    for (start, end), block in zip(ranges, blocks):
-        out.extend(block[start:end])
-    return out
-
-
-# ---------------- driver-side exchange plans ----------------
-
-
-def _as_list(refs_or_ref, nparts: int) -> List:
-    """num_returns=1 tasks return a bare ObjectRef, not a 1-list."""
-    return [refs_or_ref] if nparts == 1 else refs_or_ref
-
-
-def _exchange(refs: List, partition_task, partition_args,
-              merge_task, merge_args, nparts: int) -> List:
-    """Generic two-phase exchange. Returns reduce-output refs."""
-    part = ray_tpu.remote(num_cpus=1)(partition_task).options(
-        num_returns=nparts
-    )
-    map_outs = [
-        _as_list(part.remote(r, *partition_args), nparts) for r in refs
-    ]
-    merge = ray_tpu.remote(num_cpus=1)(merge_task)
-    out = []
-    for p in range(nparts):
-        cols = [mo[p] for mo in map_outs]
-        out.append(merge.remote(*merge_args, *cols))
-    return out
-
-
-def exact_shuffle(refs: List, nparts: int, seed: Optional[int]) -> List:
-    """Exact global random shuffle (reference random_shuffle semantics:
-    every output permutation equally likely up to rng quality)."""
-    if not refs:
-        return refs
-    base = seed if seed is not None else _random.randrange(1 << 30)
-    part = ray_tpu.remote(num_cpus=1)(_partition_random).options(
-        num_returns=nparts
-    )
-    map_outs = [
-        _as_list(part.remote(r, nparts, base * 1000003 + i), nparts)
-        for i, r in enumerate(refs)
-    ]
-    merge = ray_tpu.remote(num_cpus=1)(_merge_shuffle)
-    return [
-        merge.remote(base * 7 + p, *[mo[p] for mo in map_outs])
-        for p in range(nparts)
-    ]
-
-
-def sort_blocks(refs: List, keyfn: Callable[[Any], Any],
-                descending: bool, nparts: int) -> List:
-    """Distributed sort via sampled range partitioning; output blocks are
-    globally ordered (block i entirely <= block i+1)."""
-    if not refs:
-        return refs
-    sample = ray_tpu.remote(num_cpus=1)(_sample_keys)
-    samples: List = []
-    for i, r in enumerate(refs):
-        samples.append(sample.remote(r, 32, 1299721 * (i + 1), keyfn))
-    keys = sorted(k for s in ray_tpu.get(samples) for k in s)
-    if not keys:
-        return refs
-    # P-1 boundaries at even quantiles of the sample
-    boundaries = [
-        keys[min(len(keys) - 1, (len(keys) * (i + 1)) // nparts)]
-        for i in range(nparts - 1)
-    ]
-    if descending:
-        out = _exchange(
-            refs, _partition_by_key, (boundaries, keyfn),
-            _merge_sort, (keyfn, True), nparts,
-        )
-        return list(reversed(out))
-    return _exchange(
-        refs, _partition_by_key, (boundaries, keyfn),
-        _merge_sort, (keyfn, False), nparts,
-    )
-
-
-def groupby_reduce(refs: List, keyfn: Callable[[Any], Any],
-                   reducefn: Callable[[Any, List], Any],
-                   nparts: int) -> List:
-    """Hash-partition by key, then reduce each group exactly once."""
-    if not refs:
-        return refs
-    return _exchange(
-        refs, _partition_by_hash, (nparts, keyfn),
-        _merge_groups, (keyfn, reducefn), nparts,
-    )
+# ---------------- materializing helpers (split()) ----------------
 
 
 def repartition_blocks(refs: List, nparts: int) -> List:
-    """Exact rebalance into ``nparts`` near-equal row-count blocks without
-    moving rows through the driver: per-block counts first, then each
-    output task slices only the input blocks it overlaps."""
+    """Materialized exact rebalance into ``nparts`` near-equal row-count
+    blocks (used by Dataset.split, which needs concrete per-split refs)."""
+    import ray_tpu
+
     if not refs:
         return refs
-    count = ray_tpu.remote(num_cpus=1)(len)
+    count = ray_tpu.remote(num_cpus=1)(_count_rows)
     lengths = ray_tpu.get([count.remote(r) for r in refs])
     total = sum(lengths)
     per = -(-total // nparts) if total else 0
-    # global row offsets of each input block
     offsets = [0]
     for ln in lengths:
         offsets.append(offsets[-1] + ln)
-    slicer = ray_tpu.remote(num_cpus=1)(_slice_concat)
+
+    def slice_concat(ranges, *blocks):
+        picked = [
+            BlockAccessor.for_block(b).slice(s, e)
+            for (s, e), b in zip(ranges, blocks)
+        ]
+        return BlockAccessor.concat(picked)
+
+    slicer = ray_tpu.remote(num_cpus=1)(slice_concat)
     out = []
     for p in range(nparts):
         lo, hi = p * per, min((p + 1) * per, total)
@@ -239,14 +274,3 @@ def repartition_blocks(refs: List, nparts: int) -> List:
                 picked.append(r)
         out.append(slicer.remote(ranges, *picked))
     return out
-
-
-def make_keyfn(key) -> Callable[[Any], Any]:
-    """None -> identity; str -> row[key]; callable -> itself."""
-    if key is None:
-        return lambda r: r
-    if isinstance(key, str):
-        return lambda r: r[key]
-    if callable(key):
-        return key
-    raise TypeError(f"sort/groupby key must be None, str or callable: {key!r}")

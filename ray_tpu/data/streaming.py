@@ -1,13 +1,33 @@
 """Streaming executor: pipelined block processing with backpressure.
 
 Parity: reference ``python/ray/data/_internal/execution/streaming_executor.py``
-(:49, loop step :217) and the op-state machine
-``streaming_executor_state.py:312,376`` (``select_operator_to_run``). Blocks
-flow between operator stages as ObjectRefs (never materialized on the
-driver); each stage runs remote tasks bounded by ``max_tasks_in_flight``,
-and a stage is only scheduled when downstream buffering is under the limit —
-so a slow consumer bounds cluster memory instead of the pipeline running
-away (the core property the reference spent years on).
+(:49, loop step :217), the op-state machine ``streaming_executor_state.py``
+(:312,376 ``select_operator_to_run``), the physical operators under
+``_internal/execution/operators/`` (``TaskPoolMapOperator`` /
+``ActorPoolMapOperator``) and the exchange machinery
+(``_internal/planner/exchange/``, ``push_based_shuffle.py``). Blocks flow
+between operators as ObjectRefs (never materialized on the driver).
+
+Two operator kinds:
+
+- ``Stage`` — 1:1 per-block map, executed as remote tasks (default) or on a
+  stateful actor pool (``compute=ActorPoolStrategy(...)`` — the reference's
+  ActorPoolMapOperator; required for class UDFs that carry expensive state
+  like a loaded model).
+- ``ExchangeStage`` — an all-to-all (shuffle/sort/repartition/groupby)
+  executed INSIDE the streaming machine: an optional per-block ``prepare``
+  pass (samples/counts) runs as inputs arrive, the partition pass
+  (``num_returns=P`` tasks) runs streamingly behind upstream, and merges
+  launch in output order under the downstream buffer cap, dropping each
+  partition column's refs as soon as its merge completes. The unavoidable
+  exchange footprint (every partition output exists between the last
+  partition and its merge) lives in the object store where spilling, not
+  driver memory, absorbs datasets larger than RAM.
+
+Backpressure: a map stage is only scheduled when its un-consumed output +
+in-flight (+ the terminal reorder buffer for the last stage) is under
+``max_buffered_blocks``; the block the ordered consumer needs next bypasses
+the cap so a full reorder buffer can't deadlock behind one straggler.
 
 TPU shape: the terminal consumer is typically a host feeding
 ``jax.device_put`` / ``make_array_from_process_local_data``; keeping the
@@ -16,105 +36,367 @@ object plane as the buffer means host RAM, not HBM, absorbs burstiness.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterator, List
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu
 
 
-class Stage:
-    """One operator: a per-block transform executed as remote tasks.
+class ActorPoolStrategy:
+    """``map_batches(..., compute=ActorPoolStrategy(size=n))`` — process
+    blocks on ``size`` long-lived actors instead of stateless tasks
+    (parity: reference ``ActorPoolMapOperator`` / ``ActorPoolStrategy``).
+    Class UDFs are constructed once per actor."""
 
+    def __init__(self, size: int = 2, max_tasks_in_flight_per_actor: int = 2):
+        if size < 1:
+            raise ValueError("ActorPoolStrategy size must be >= 1")
+        self.size = size
+        self.per_actor = max_tasks_in_flight_per_actor
+
+
+class Stage:
+    """One 1:1 map operator.
+
+    ``fn``: block-UDF (or a class — actors only), receiving the block in
+    ``batch_format``: None = native block form (row ``list`` or columnar
+    ``dict[str, np.ndarray]``), "rows" = list of rows, "numpy" = columnar
+    batch. Returns rows, a dict-of-arrays, or an ndarray.
     ``with_index=True`` passes the block's pipeline position as a second
-    argument (stages are 1:1 per block, so the index is stable end-to-end) —
-    used e.g. to derive distinct per-block shuffle seeds."""
+    argument (map stages are 1:1, so the index is stable end-to-end)."""
 
     def __init__(self, name: str, fn: Callable, num_cpus: float = 1.0,
-                 with_index: bool = False):
+                 with_index: bool = False,
+                 batch_format: Optional[str] = None,
+                 compute: Optional[ActorPoolStrategy] = None):
+        if batch_format not in (None, "rows", "numpy"):
+            raise ValueError(f"unknown batch_format {batch_format!r}")
+        if isinstance(fn, type) and compute is None:
+            raise ValueError(
+                "class UDFs require compute=ActorPoolStrategy(...) "
+                "(state lives on pool actors, not per-task)"
+            )
         self.name = name
         self.fn = fn
         self.num_cpus = num_cpus
         self.with_index = with_index
+        self.batch_format = batch_format
+        self.compute = compute
 
     def __repr__(self):
         return f"Stage({self.name})"
 
 
-def _apply_stage_fn(fn, with_index, idx, block):
-    return fn(block, idx) if with_index else fn(block)
+class ExchangeStage:
+    """One all-to-all operator: prepare? -> partition -> merge.
+
+    ``prepare_fn(block) -> meta``: optional per-input-block pass (e.g. key
+    samples for sort boundaries, row counts for repartition) run as blocks
+    arrive; ``make_partition(metas: dict[idx -> meta]) -> partition_fn``
+    builds the partition body once all metas are in (called immediately
+    with ``{}`` when there is no prepare pass);
+    ``partition_fn(block, idx) -> P blocks``; ``merge_fn(p, *parts) ->
+    block`` merges column ``p`` of every input."""
+
+    def __init__(self, name: str, nparts: int,
+                 make_partition: Callable[[Dict[int, Any]], Callable],
+                 merge_fn: Callable, prepare_fn: Optional[Callable] = None,
+                 num_cpus: float = 1.0):
+        if nparts < 1:
+            raise ValueError("nparts must be >= 1")
+        self.name = name
+        self.nparts = nparts
+        self.make_partition = make_partition
+        self.merge_fn = merge_fn
+        self.prepare_fn = prepare_fn
+        self.num_cpus = num_cpus
+
+    def __repr__(self):
+        return f"ExchangeStage({self.name}, P={self.nparts})"
+
+
+# ---------------- task bodies ----------------
+
+
+def _run_stage_fn(fn, batch_format, with_index, idx, block):
+    from ray_tpu.data.block import BlockAccessor
+
+    if batch_format is None:
+        arg = block
+    else:
+        acc = BlockAccessor.for_block(block)
+        arg = acc.to_rows() if batch_format == "rows" else (
+            acc.to_numpy_batch()
+        )
+    out = fn(arg, idx) if with_index else fn(arg)
+    return BlockAccessor.batch_to_block(out)
+
+
+class _PoolWorker:
+    """Actor-pool worker: constructs a class UDF once, applies it per block."""
+
+    def __init__(self, fn_or_cls, batch_format, with_index):
+        self._fn = fn_or_cls() if isinstance(fn_or_cls, type) else fn_or_cls
+        self._fmt = batch_format
+        self._with_index = with_index
+
+    def apply(self, idx, block):
+        return _run_stage_fn(self._fn, self._fmt, self._with_index, idx,
+                             block)
+
+
+def _run_partition(partition_fn, idx, nparts, block):
+    parts = partition_fn(block, idx)
+    if len(parts) != nparts:
+        raise ValueError(
+            f"partition_fn returned {len(parts)} parts, expected {nparts}"
+        )
+    return parts[0] if nparts == 1 else tuple(parts)
+
+
+def _run_merge(merge_fn, p, *parts):
+    return merge_fn(p, *parts)
+
+
+# ---------------- executor ----------------
+
+_MAP, _EXCHANGE = "map", "exchange"
+
+
+class _OpState:
+    """Driver-side runtime state for one operator."""
+
+    def __init__(self, stage, index: int):
+        self.stage = stage
+        self.index = index
+        self.kind = _EXCHANGE if isinstance(stage, ExchangeStage) else _MAP
+        self.inputs: List[Tuple[int, Any]] = []   # (idx, ref) pending
+        self.inflight: Dict[Any, Tuple] = {}      # signal ref -> meta
+        self.outputs: List[Tuple[int, Any]] = []  # (idx, ref) finished
+        self.no_more_inputs = False
+        # map/actor-pool state
+        self.pool: List = []            # actors (lazy)
+        self.pool_load: List[int] = []  # in-flight per actor
+        # exchange state
+        self.phase = "prepare"          # prepare -> partition -> merge
+        self.metas: Dict[int, Any] = {}
+        self.held: List[Tuple[int, Any]] = []   # inputs awaiting partition
+        self.parts: Dict[int, List] = {}        # input idx -> P part refs
+        self.partition_fn = None
+        self.partition_task = None
+        self.merge_task = None
+        self.merges_launched = 0
+        self.merge_order: Optional[List[int]] = None  # sorted input idxs
+
+    def done(self) -> bool:
+        base = (self.no_more_inputs and not self.inputs
+                and not self.inflight and not self.outputs)
+        if self.kind == _MAP:
+            return base
+        return (base and not self.held
+                and (self.phase == "merge")
+                and self.merges_launched >= self.stage.nparts)
 
 
 class StreamingExecutor:
     """Pull-based streaming execution of ``stages`` over ``source_blocks``.
 
-    ``max_tasks_in_flight``: per-stage concurrent task cap.
-    ``max_buffered_blocks``: per-stage output-queue cap — the backpressure
-    valve: a stage whose output queue is full is not scheduled.
+    ``max_tasks_in_flight``: per-operator concurrent task cap.
+    ``max_buffered_blocks``: per-map-stage output-queue cap — the
+    backpressure valve. Exchange partition outputs are exempt (the
+    all-to-all footprint is inherent and spillable; see module docstring).
     """
 
     def __init__(
         self,
-        stages: List[Stage],
+        stages: List[Any],
         source_blocks: List[Any],  # ObjectRefs of input blocks
         max_tasks_in_flight: int = 4,
         max_buffered_blocks: int = 4,
     ):
-        self.stages = stages
         self.max_in_flight = max_tasks_in_flight
         self.max_buffered = max_buffered_blocks
-        # per-stage state: input queue, in-flight refs, output queue.
-        # queue entries are (block_index, ref) pairs; the index is stable
-        # through the 1:1 stages.
-        n = len(stages)
-        self._inputs: List[List] = [[] for _ in range(n)]
-        self._inflight: List[Dict] = [dict() for _ in range(n)]  # ref->idx
-        self._outputs: List[List] = [[] for _ in range(n)]
-        if n:
-            self._inputs[0] = list(enumerate(source_blocks))
+        self.ops = [_OpState(s, i) for i, s in enumerate(stages)]
+        self._source = list(enumerate(source_blocks))
+        self._no_op_outputs: List[Tuple[int, Any]] = []
+        if self.ops:
+            self.ops[0].inputs = list(self._source)
+            self.ops[0].no_more_inputs = True
         else:
-            self._outputs.append(list(enumerate(source_blocks)))
+            self._no_op_outputs = list(self._source)
         self._peak_buffered = 0  # observability / tests
-        # Ordered-consumption state: blocks held for in-order yield count
-        # toward the final stage's buffer cap (they are materialized memory
-        # exactly like an output-queue entry), and the block the consumer
-        # needs next (_next_idx) bypasses the cap so a straggler can't
-        # deadlock a full reorder buffer.
-        self._ready: Dict[int, Any] = {}
+        self._ready: Dict[int, Any] = {}  # terminal reorder buffer
         self._next_idx = 0
+        # ops at/after this index feed the ordered terminal through 1:1
+        # maps only — HOL bypass applies there; ops before the last
+        # exchange feed an unordered consumer (the exchange itself).
+        last_ex = max(
+            (i for i, o in enumerate(self.ops) if o.kind == _EXCHANGE),
+            default=-1,
+        )
+        self._ordered_from = last_ex + 1
 
-    # -- scheduling core (parity: select_operator_to_run) --
+    # -- backpressure accounting --
 
     def _buffered(self, i: int) -> int:
-        """Blocks this stage is responsible for in memory: finished outputs
-        + in-flight results + (for the last stage) the consumer-side reorder
-        buffer — the reorder buffer is real materialized memory and must
-        count, or one straggler lets the whole pipeline run ahead."""
-        n = len(self._outputs[i]) + len(self._inflight[i])
-        if i == len(self.stages) - 1:
+        op = self.ops[i]
+        n = len(op.outputs)
+        if op.kind == _MAP:
+            n += len(op.inflight)
+        else:
+            n += sum(1 for m in op.inflight.values() if m[0] == "merge")
+        if i == len(self.ops) - 1:
             n += len(self._ready)
         return n
 
+    def _wants_next(self, entries, i: int) -> bool:
+        """Does this (idx, ref) list contain the terminal's next block?"""
+        if i < self._ordered_from:
+            return False
+        return any(idx == self._next_idx for idx, _ in entries)
+
+    # -- scheduling --
+
     def _schedulable(self, i: int) -> bool:
-        if not self._inputs[i]:
+        op = self.ops[i]
+        if op.kind == _MAP:
+            if not op.inputs:
+                return False
+            if len(op.inflight) >= self.max_in_flight:
+                return False
+            if op.stage.compute is not None and op.pool and not any(
+                load < op.stage.compute.per_actor for load in op.pool_load
+            ):
+                return False  # every pool actor is at its in-flight cap
+            if self._buffered(i) < self.max_buffered:
+                return True
+            return self._wants_next(op.inputs, i)
+        return self._exchange_schedulable(op)
+
+    def _exchange_schedulable(self, op: "_OpState") -> bool:
+        st = op.stage
+        if op.phase == "prepare":
+            if st.prepare_fn is None:
+                # no prepare pass: partition directly
+                if op.partition_fn is None:
+                    op.partition_fn = st.make_partition({})
+                op.phase = "partition"
+                return self._exchange_schedulable(op)
+            if (op.no_more_inputs and not op.inputs and not op.inflight):
+                # all prepares done (or zero inputs): move on
+                op.partition_fn = st.make_partition(op.metas)
+                op.phase = "partition"
+                return self._exchange_schedulable(op)
+            return bool(op.inputs) and len(op.inflight) < self.max_in_flight
+        if op.phase == "partition":
+            if op.inputs or op.held:
+                return len(op.inflight) < self.max_in_flight
+            if (op.no_more_inputs and not op.inflight
+                    and op.partition_fn is not None):
+                op.merge_order = sorted(op.parts)
+                op.phase = "merge"
+                return self._exchange_schedulable(op)
             return False
-        if len(self._inflight[i]) >= self.max_in_flight:
+        # merge phase: launch merges in output order, under the output cap
+        if op.merges_launched >= st.nparts:
             return False
-        if self._buffered(i) < self.max_buffered:
+        if len(op.inflight) >= self.max_in_flight:
+            return False
+        if self._buffered(op.index) < self.max_buffered:
             return True
-        # Head-of-line bypass: the block the ordered consumer is waiting on
-        # may always proceed, else a full reorder buffer deadlocks on a
-        # straggler that can no longer be scheduled.
-        return any(idx == self._next_idx for idx, _ in self._inputs[i])
+        # HOL: the next merge IS the terminal's next block when only maps
+        # follow this exchange
+        return (op.index >= self._ordered_from - 1
+                and op.merges_launched == self._next_idx)
 
     def _launch(self, i: int):
-        stage = self.stages[i]
+        op = self.ops[i]
+        if op.kind == _MAP:
+            self._launch_map(op)
+        else:
+            self._launch_exchange(op)
+
+    def _launch_map(self, op: "_OpState"):
+        st = op.stage
         # Pop the lowest pipeline index first: the ordered consumer wants
         # low indices, and FIFO arrival order is not index order once
         # upstream tasks complete out of order.
-        k = min(range(len(self._inputs[i])), key=lambda j: self._inputs[i][j][0])
-        idx, block_ref = self._inputs[i].pop(k)
-        task = ray_tpu.remote(num_cpus=stage.num_cpus)(_apply_stage_fn)
-        out_ref = task.remote(stage.fn, stage.with_index, idx, block_ref)
-        self._inflight[i][out_ref] = idx
+        k = min(range(len(op.inputs)), key=lambda j: op.inputs[j][0])
+        idx, block_ref = op.inputs.pop(k)
+        if st.compute is not None:
+            if not op.pool:
+                actor_cls = ray_tpu.remote(num_cpus=st.num_cpus)(_PoolWorker)
+                op.pool = [
+                    actor_cls.remote(st.fn, st.batch_format, st.with_index)
+                    for _ in range(st.compute.size)
+                ]
+                op.pool_load = [0] * len(op.pool)
+            a = min(range(len(op.pool)), key=lambda j: op.pool_load[j])
+            out_ref = op.pool[a].apply.remote(idx, block_ref)
+            op.pool_load[a] += 1
+            op.inflight[out_ref] = ("map", idx, a)
+            return
+        task = ray_tpu.remote(num_cpus=st.num_cpus)(_run_stage_fn)
+        out_ref = task.remote(st.fn, st.batch_format, st.with_index, idx,
+                              block_ref)
+        op.inflight[out_ref] = ("map", idx, None)
+
+    def _launch_exchange(self, op: "_OpState"):
+        st = op.stage
+        if op.phase == "prepare":
+            idx, ref = op.inputs.pop(0)
+            op.held.append((idx, ref))
+            task = ray_tpu.remote(num_cpus=st.num_cpus)(st.prepare_fn)
+            sig = task.remote(ref)
+            op.inflight[sig] = ("prepare", idx)
+            return
+        if op.phase == "partition":
+            if op.inputs:
+                idx, ref = op.inputs.pop(0)
+            else:
+                idx, ref = op.held.pop(0)
+            task = ray_tpu.remote(num_cpus=st.num_cpus)(
+                _run_partition
+            ).options(num_returns=st.nparts)
+            out = task.remote(op.partition_fn, idx, st.nparts, ref)
+            refs = [out] if st.nparts == 1 else list(out)
+            op.parts[idx] = refs
+            # signal ref (part 0) carries the input ref so it stays alive
+            # until the partition task has consumed it
+            op.inflight[refs[0]] = ("part", idx, ref)
+            return
+        # merge
+        p = op.merges_launched
+        op.merges_launched += 1
+        cols = [op.parts[j][p] for j in op.merge_order]
+        task = ray_tpu.remote(num_cpus=st.num_cpus)(_run_merge)
+        sig = task.remote(st.merge_fn, p, *cols)
+        op.inflight[sig] = ("merge", p)
+
+    # -- pump --
+
+    def _harvest_one(self, op: "_OpState", sig, meta):
+        kind = meta[0]
+        if kind == "map":
+            idx, actor = meta[1], meta[2]
+            op.outputs.append((idx, sig))
+            if actor is not None:
+                op.pool_load[actor] -= 1
+        elif kind == "prepare":
+            op.metas[meta[1]] = ray_tpu.get(sig)
+            if (op.no_more_inputs and not op.inputs and not any(
+                m[0] == "prepare" for m in op.inflight.values()
+            )):
+                op.partition_fn = op.stage.make_partition(op.metas)
+                op.phase = "partition"
+        elif kind == "part":
+            pass  # parts recorded at launch; input ref now droppable
+        elif kind == "merge":
+            p = meta[1]
+            op.outputs.append((p, sig))
+            # free this partition column: its refs are no longer needed
+            for j in list(op.parts):
+                if p < len(op.parts[j]):
+                    op.parts[j][p] = None
 
     def _pump(self, timeout: float = 0.2) -> bool:
         """One loop step: launch what's schedulable, harvest what finished.
@@ -122,93 +404,118 @@ class StreamingExecutor:
         launched = False
         # Prefer downstream stages (drain before filling; reference's
         # select_operator_to_run ranks by downstream memory usage).
-        for i in reversed(range(len(self.stages))):
+        for i in reversed(range(len(self.ops))):
             while self._schedulable(i):
                 self._launch(i)
                 launched = True
-        all_inflight = [r for infl in self._inflight for r in infl]
+        all_inflight = [
+            (sig, op) for op in self.ops for sig in op.inflight
+        ]
         if all_inflight:
             ready, _ = ray_tpu.wait(
-                all_inflight,
+                [sig for sig, _ in all_inflight],
                 num_returns=1,
                 timeout=None if launched else timeout,
                 fetch_local=False,
             )
-            for r in ready:
-                for i, infl in enumerate(self._inflight):
-                    if r in infl:
-                        self._outputs[i].append((infl.pop(r), r))
-                        break
+            ready_set = set(ready)
+            for sig, op in all_inflight:
+                if sig in ready_set:
+                    self._harvest_one(op, sig, op.inflight.pop(sig))
         buffered = (
-            sum(len(q) for q in self._outputs)
-            + sum(len(f) for f in self._inflight)
-            + len(self._ready)
+            sum(self._buffered(i) for i in range(len(self.ops)))
+            # _buffered(last) already counted _ready once; don't recount
         )
         self._peak_buffered = max(self._peak_buffered, buffered)
         return bool(all_inflight or launched)
 
-    # -- consumption --
+    # -- wiring --
 
     def _wire(self):
         """Move finished blocks downstream — but only while the downstream
         stage is under its buffer cap, so backpressure propagates upstream
-        (a full stage j stalls stage j-1's scheduling via its output queue)."""
-        for i in range(len(self.stages) - 1):
+        (a full stage j stalls stage j-1's scheduling via its output
+        queue). The terminal's head-of-line block moves regardless."""
+        for i in range(len(self.ops) - 1):
             j = i + 1
-            while self._outputs[i]:
+            dn = self.ops[j]
+            while self.ops[i].outputs:
+                if dn.kind == _EXCHANGE:
+                    # exchanges consume unordered and retain inputs anyway;
+                    # keep their pending queue modest, no ordering logic
+                    if len(dn.inputs) >= self.max_buffered + (
+                        self.max_in_flight
+                    ):
+                        break
+                    dn.inputs.append(self.ops[i].outputs.pop(0))
+                    continue
                 under_cap = (
-                    len(self._inputs[j]) + self._buffered(j) < self.max_buffered
+                    len(dn.inputs) + self._buffered(j) < self.max_buffered
                 )
-                # Head-of-line block moves regardless of cap (see
-                # _schedulable) so the ordered consumer always progresses.
-                has_next = any(
-                    idx == self._next_idx for idx, _ in self._outputs[i]
-                )
+                has_next = self._wants_next(self.ops[i].outputs, j)
                 if not under_cap and not has_next:
                     break
                 if under_cap:
                     k = 0
                 else:
                     k = next(
-                        k for k, (idx, _) in enumerate(self._outputs[i])
+                        k for k, (idx, _) in enumerate(self.ops[i].outputs)
                         if idx == self._next_idx
                     )
-                self._inputs[j].append(self._outputs[i].pop(k))
+                dn.inputs.append(self.ops[i].outputs.pop(k))
+        # propagate upstream-done flags (op 0 is seeded at init)
+        for i in range(1, len(self.ops)):
+            up = self.ops[i - 1]
+            self.ops[i].no_more_inputs = (
+                up.no_more_inputs and not up.inputs and not up.inflight
+                and not up.outputs and not up.held
+                and (up.kind == _MAP or (
+                    up.phase == "merge"
+                    and up.merges_launched >= up.stage.nparts
+                ))
+            )
 
     def _done(self) -> bool:
-        # Mid-stage outputs still count as pending work: declaring done while
-        # a block sits in an intermediate output queue (downstream at cap)
-        # would silently drop it.
-        return (
-            not any(self._inputs)
-            and not any(self._inflight)
-            and not any(self._outputs[:-1])
-        )
+        return all(op.done() for op in self.ops)
+
+    # -- consumption --
 
     def iter_output_refs(self) -> Iterator[Any]:
-        """Yield final-stage block refs in SOURCE-BLOCK ORDER as they
+        """Yield final-stage block refs in OUTPUT-INDEX ORDER as they
         materialize (reference parity: dataset iteration order is
         deterministic). Out-of-order blocks wait in ``self._ready``, which
         counts toward the last stage's buffer cap (``_buffered``) so the
         pipeline cannot run ahead behind one straggler; the head-of-line
         block bypasses the cap so that straggler always completes."""
-        if not self.stages:
-            for _idx, ref in self._outputs[-1]:
+        if not self.ops:
+            for _idx, ref in self._no_op_outputs:
                 yield ref
             return
-        last = len(self.stages) - 1
-        while True:
-            self._wire()
-            while self._outputs[last]:
-                idx, ref = self._outputs[last].pop(0)
-                self._ready[idx] = ref
-            while self._next_idx in self._ready:
-                yield self._ready.pop(self._next_idx)
-                self._next_idx += 1
-            if self._done():
-                # any stragglers (should be none): emit in index order
-                for idx in sorted(self._ready):
-                    yield self._ready.pop(idx)
-                self._next_idx = 0
-                return
-            self._pump()
+        last = self.ops[-1]
+        try:
+            while True:
+                self._wire()
+                while last.outputs:
+                    idx, ref = last.outputs.pop(0)
+                    self._ready[idx] = ref
+                while self._next_idx in self._ready:
+                    yield self._ready.pop(self._next_idx)
+                    self._next_idx += 1
+                if self._done():
+                    for idx in sorted(self._ready):  # stragglers: none expected
+                        yield self._ready.pop(idx)
+                    self._next_idx = 0
+                    return
+                self._pump()
+        finally:
+            # covers early exit (take(n) closing the generator) too
+            self._shutdown_pools()
+
+    def _shutdown_pools(self):
+        for op in self.ops:
+            for a in op.pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+            op.pool = []

@@ -21,6 +21,22 @@ def rt_data():
     ray_tpu.shutdown()
 
 
+@pytest.fixture
+def rt_data_small_store():
+    # 32 MiB store + spilling enabled: datasets bigger than the store must
+    # flow by spilling, not by pinning everything resident
+    ray_tpu.init(
+        num_cpus=4,
+        object_store_memory=32 * 1024 * 1024,
+        system_config={
+            "object_spilling_enabled": True,
+            "object_spilling_threshold": 0.5,
+        },
+    )
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
 def test_from_items_roundtrip(rt_data):
     ds = rd.from_items(list(range(100)), parallelism=8)
     assert ds.num_blocks() == 8
@@ -361,15 +377,145 @@ def test_single_block_barrier_ops(rt_data):
     assert counts == {0: 3, 1: 3}
 
 
-def test_barrier_ops_lazy_and_cached(rt_data):
-    """Calling a barrier op must not execute the plan (laziness contract);
-    consuming twice must not re-run the exchange (factory result cached)."""
+def test_barrier_ops_lazy_and_deterministic(rt_data):
+    """Calling an all-to-all op must not execute the plan: it appends an
+    ExchangeStage that runs INSIDE the streaming executor on consumption.
+    A seeded shuffle re-executes deterministically; materialize() pins the
+    result to concrete refs for repeated consumption without re-running."""
+    from ray_tpu.data.streaming import ExchangeStage
+
     ds = rd.from_items(list(range(40)), parallelism=4)
     shuffled = ds.random_shuffle(seed=3)
-    assert shuffled._source is None  # nothing executed at call time
+    # lazy: same source refs, one more (unexecuted) stage in the plan
+    assert shuffled._source is ds._source
+    assert isinstance(shuffled._stages[-1], ExchangeStage)
     first = shuffled.take_all()
-    assert shuffled._source is not None
-    cached = shuffled._source
     second = shuffled.take_all()
-    assert shuffled._source is cached  # same exchange output reused
-    assert first == second  # deterministic: same materialized blocks
+    assert first == second  # seeded exchange re-executes deterministically
+    mat = shuffled.materialize()
+    assert not mat._stages  # stage-free: consumption is just ref reads
+    assert mat.take_all() == first
+
+
+# ---------------- round 3: columnar blocks + streaming exchange + actor pools ----------------
+
+
+def test_actor_pool_map_batches(rt_data):
+    """compute=ActorPoolStrategy: class UDFs are constructed once per actor
+    (parity: reference ActorPoolMapOperator) — not once per block."""
+    import os
+
+    class AddPid:
+        def __init__(self):
+            self.pid = os.getpid()
+            self.calls = 0
+
+        def __call__(self, rows):
+            self.calls += 1
+            return [{"v": r, "pid": self.pid, "call": self.calls}
+                    for r in rows]
+
+    ds = rd.from_items(list(range(40)), parallelism=8).map_batches(
+        AddPid, batch_format="rows", compute=rd.ActorPoolStrategy(size=2)
+    )
+    rows = ds.take_all()
+    assert sorted(r["v"] for r in rows) == list(range(40))
+    pids = {r["pid"] for r in rows}
+    assert len(pids) <= 2  # all 8 blocks ran on <=2 pool actors
+    # statefulness: some actor saw more than one block
+    assert max(r["call"] for r in rows) > 1
+
+
+def test_class_udf_requires_actor_pool(rt_data):
+    class F:
+        def __call__(self, rows):
+            return rows
+
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        rd.from_items([1]).map_batches(F)
+
+
+def test_columnar_zero_copy_ingest(rt_data):
+    """Columnar blocks reach iter_batches as views over the object store —
+    no per-row copies on the trainer ingest path."""
+    import numpy as np
+
+    arr = np.arange(4000, dtype=np.float32).reshape(1000, 4)
+    ds = rd.from_numpy(arr, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=100, batch_format="numpy"))
+    assert len(batches) == 10
+    # a batch cut inside one block is a zero-copy view, not a fresh array
+    assert not batches[0].flags["OWNDATA"]
+    got = np.concatenate(batches)
+    assert (got == arr).all()
+
+
+def test_map_batches_numpy_format_columnar_through(rt_data):
+    """batch_format='numpy' UDFs consume and produce columnar blocks."""
+    import numpy as np
+
+    ds = rd.from_pandas(
+        __import__("pandas").DataFrame(
+            {"x": np.arange(50, dtype=np.float64), "y": np.ones(50)}
+        ),
+        parallelism=4,
+    ).map_batches(
+        lambda b: {"z": b["x"] * 2 + b["y"]}, batch_format="numpy"
+    )
+    out = list(ds.iter_batches(batch_size=25, batch_format="numpy"))
+    z = np.concatenate([b["z"] for b in out])
+    assert np.allclose(np.sort(z), np.arange(50) * 2 + 1)
+
+
+def test_exchange_streams_inside_executor(rt_data):
+    """map -> shuffle -> map -> sort chains run in ONE streaming executor;
+    no driver-side materialization between stages."""
+    ds = (
+        rd.range(200, parallelism=8)
+        .map(lambda x: int(x) * 2)
+        .random_shuffle(seed=11)
+        .map(lambda x: x + 1)
+        .sort()
+    )
+    out = ds.take_all()
+    assert out == [x * 2 + 1 for x in range(200)]
+    # plan is a single executor run: 5 stages, 2 of them exchanges
+    from ray_tpu.data.streaming import ExchangeStage
+
+    assert sum(isinstance(s, ExchangeStage) for s in ds._stages) == 2
+
+
+def test_columnar_sort_and_shuffle_vectorized(rt_data):
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    vals = rng.permutation(500).astype(np.int64)
+    ds = rd.from_numpy(vals, parallelism=5).sort()
+    got = np.asarray(ds.take_all())
+    assert (got == np.arange(500)).all()
+    desc = rd.from_numpy(vals, parallelism=5).sort(descending=True)
+    got_d = np.asarray(desc.take_all())
+    assert (got_d == np.arange(499, -1, -1)).all()
+
+
+def test_shuffle_larger_than_object_store(rt_data_small_store):
+    """VERDICT round-3 criterion: a shuffle of a dataset ~4x the object
+    store completes — partition outputs spill instead of pinning."""
+    import numpy as np
+
+    # 64 blocks x 2 MiB = 128 MiB through a 32 MiB store
+    nblocks, rows_per = 64, 512
+    ds = rd.from_items(
+        list(range(nblocks)), parallelism=nblocks
+    ).map_batches(
+        lambda b: {"x": np.full((rows_per, 1024), b[0], np.float32),
+                   "i": np.full(rows_per, b[0], np.int64)},
+        batch_format="rows",
+    ).random_shuffle(seed=3)
+    seen = np.zeros(nblocks, dtype=np.int64)
+    total = 0
+    for batch in ds.iter_batches(batch_size=256, batch_format="numpy"):
+        np.add.at(seen, batch["i"], 1)
+        total += len(batch["i"])
+    assert total == nblocks * rows_per
+    assert (seen == rows_per).all()
