@@ -87,6 +87,10 @@ _d("health_check_period_ms", int, 1000)
 _d("health_check_timeout_ms", int, 10000)
 _d("num_heartbeats_timeout", int, 30)
 _d("lineage_pinning_enabled", bool, True)
+# streaming generators: executor pauses when this many reported yields are
+# unconsumed by the caller (parity: reference
+# _generator_backpressure_num_objects)
+_d("streaming_generator_backpressure_items", int, 8)
 _d("max_lineage_bytes", int, 1024**3)
 _d("prestart_workers", bool, True)
 _d("worker_pool_min_idle", int, 0)

@@ -134,6 +134,124 @@ class MemoryStore:
         return len(self._table)
 
 
+class _GeneratorStream:
+    """Caller-side state of ONE streaming generator task (parity: reference
+    StreamingObjectRefGenerator bookkeeping in task_manager.cc).
+
+    The executing worker reports yields one at a time; the consumer thread
+    pulls refs out in order. ``reported``/``consumed`` drive backpressure:
+    the report RPC's reply is DELAYED while unconsumed >= the configured
+    limit, which blocks the executor's generator loop — flow control with
+    no polling. Re-execution after a worker death re-reports from index 0;
+    ``on_item`` only advances for the contiguous next index, so duplicates
+    refresh object bytes without disturbing consumer progress."""
+
+    def __init__(self, worker, spec):
+        self._worker = worker
+        self.spec = spec
+        self.task_id = spec.task_id
+        self.reported = 0  # contiguous items stored
+        self.total: Optional[int] = None  # yield count once finished
+        self.error: Optional[BaseException] = None
+        self.consumed = 0
+        self.cancelled = False  # consumer abandoned the stream
+        self._cond = threading.Condition()
+        self._bp_waiters: List = []  # asyncio futures (on worker.io.loop)
+
+    def on_item(self, index: int):
+        with self._cond:
+            if index == self.reported:
+                self.reported += 1
+                self._cond.notify_all()
+
+    def finalize(self, total: Optional[int] = None,
+                 error: Optional[BaseException] = None):
+        with self._cond:
+            if total is not None and self.total is None:
+                self.total = total
+            if error is not None and self.error is None:
+                self.error = error
+            self._cond.notify_all()
+        self._wake_bp()
+
+    def next_ref(self, timeout: Optional[float] = None):
+        """Next yield's ObjectRef (blocking); None = end of stream."""
+        from ray_tpu._private.protocol import yield_object_id
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self.consumed < self.reported:
+                    i = self.consumed
+                    self.consumed += 1
+                    ref = ObjectRef(
+                        yield_object_id(TaskID(self.task_id), i),
+                        self._worker.address.to_wire(),
+                    )
+                    break
+                if self.error is not None:
+                    self._worker._gen_streams.pop(self.task_id, None)
+                    raise self.error
+                if self.total is not None and self.consumed >= self.total:
+                    # fully drained: drop the caller-side stream record
+                    # (late lineage re-reports are handled stream-less)
+                    self._worker._gen_streams.pop(self.task_id, None)
+                    return None
+                if self.cancelled:
+                    return None
+                remaining = 0.2 if deadline is None else min(
+                    0.2, deadline - time.monotonic()
+                )
+                if remaining <= 0:
+                    raise TimeoutError(
+                        "no generator item reported within timeout"
+                    )
+                self._cond.wait(timeout=remaining)
+        self._wake_bp()
+        return ref
+
+    def cancel(self):
+        """Consumer abandons the stream: wake a parked backpressure ack so
+        the next report is NACKed and the executor's generator loop stops.
+        The stream record stays in _gen_streams until the task's final
+        reply arrives (which removes it) so the NACK is deliverable."""
+        with self._cond:
+            if self.cancelled or (self.total is not None):
+                return
+            self.cancelled = True
+            self._cond.notify_all()
+        self._wake_bp()
+
+    def _wake_bp(self):
+        loop = self._worker.io.loop
+
+        def wake():
+            waiters, self._bp_waiters = self._bp_waiters, []
+            for f in waiters:
+                if not f.done():
+                    f.set_result(None)
+
+        try:
+            loop.call_soon_threadsafe(wake)
+        except RuntimeError:
+            pass  # loop torn down at shutdown
+
+    async def backpressure_wait(self, limit: int):
+        """Await (on the IO loop) until the consumer drains below limit."""
+        while (
+            self.reported - self.consumed >= limit
+            and self.error is None and self.total is None
+            and not self.cancelled
+        ):
+            fut = asyncio.get_running_loop().create_future()
+            self._bp_waiters.append(fut)
+            await fut
+
+    def __repr__(self):
+        return (f"stream(reported={self.reported}, consumed={self.consumed},"
+                f" total={self.total})")
+
+
 class _LeaseState:
     def __init__(self):
         self.queue: collections.deque = collections.deque()
@@ -214,6 +332,9 @@ class CoreWorker:
 
         # task manager (owner side)
         self._pending_tasks: Dict[bytes, Dict] = {}
+        # streaming generator tasks this worker CALLED: task_id -> stream
+        # (kept after completion so lineage re-execution can re-report)
+        self._gen_streams: Dict[bytes, "_GeneratorStream"] = {}
         self._cancelled: set = set()  # task_ids cancelled before dispatch
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lineage_pinned: Dict[bytes, List] = {}  # task_id -> arg refs
@@ -668,6 +789,42 @@ class CoreWorker:
         self.io.submit(self._submit_async(spec))
         return True
 
+    async def rpc_report_generator_item(self, conn, data: Dict):
+        """Executor -> caller: one streaming-generator yield (parity:
+        reference ReportGeneratorItemReturns, core_worker.proto). The CALLER
+        stores the object under its deterministic id and owns it from here
+        (lineage registered, so a lost yield resubmits the task). The reply
+        is delayed while the consumer is behind — that delay IS the
+        backpressure on the executing generator."""
+        task_id = bytes(data["task_id"])
+        index = int(data["index"])
+        stream = self._gen_streams.get(task_id)
+        from ray_tpu._private.protocol import yield_object_id
+
+        oid = yield_object_id(TaskID(task_id), index)
+        if data["kind"] == "v":
+            value = serialization.unpack(bytes(data["payload"]))
+            if isinstance(value, exc.ErrorObject):
+                self.memory_store.put_error(oid, value.error)
+            else:
+                self.memory_store.put_value(oid, value)
+        else:
+            self.memory_store.put_plasma(oid, [bytes(data["node"])])
+        self._owned.add(oid)
+        if stream is None:
+            # stream record already drained/dropped: this is a lineage
+            # re-execution recreating lost yields — store and ack, no
+            # consumer bookkeeping needed
+            return {"ok": True}
+        if GLOBAL_CONFIG.lineage_pinning_enabled:
+            self._lineage[oid] = stream.spec
+        stream.on_item(index)
+        await stream.backpressure_wait(
+            GLOBAL_CONFIG.streaming_generator_backpressure_items
+        )
+        # a cancelled stream NACKs so the executor stops generating
+        return {"ok": not stream.cancelled}
+
     async def rpc_get_object(self, conn, oid_bytes: bytes):
         """Serve an owned object's value to a borrower."""
         oid = ObjectID(oid_bytes)
@@ -841,6 +998,16 @@ class CoreWorker:
             "retries_left": spec.max_retries,
             "pinned": pinned or [],
         }
+        if num_returns == -2:
+            # streaming generator: the caller owns every yield; hand back
+            # the stream handle instead of plain refs
+            from ray_tpu._private.object_ref import (
+                StreamingObjectRefGenerator,
+            )
+
+            stream = _GeneratorStream(self, spec)
+            self._gen_streams[spec.task_id] = stream
+            refs = [StreamingObjectRefGenerator(stream, refs[0])]
         self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
         self.io.submit(self._submit_async(spec))
         return refs
@@ -1098,6 +1265,23 @@ class CoreWorker:
                     self.memory_store.put_value(oid, value)
             elif kind == "p":
                 self.memory_store.put_plasma(oid, [worker_addr[2]])
+        if spec.num_returns == -2:
+            stream = self._gen_streams.get(spec.task_id)
+            if stream is not None:
+                if user_error is not None:
+                    ent = self.memory_store.get(spec.return_ids()[0])
+                    err = (
+                        ent.value
+                        if ent is not None and ent.kind == "error"
+                        else exc.TaskError(function_name=spec.name,
+                                           traceback_str=str(user_error),
+                                           cause=None)
+                    )
+                    stream.finalize(error=err)
+                else:
+                    stream.finalize(total=int(reply.get("num_yields", 0)))
+                if stream.cancelled:
+                    self._gen_streams.pop(spec.task_id, None)
         info = self._pending_tasks.pop(spec.task_id, None)
         self._recovering.discard(spec.task_id)
         if info and info.get("pinned"):
@@ -1134,6 +1318,12 @@ class CoreWorker:
             )
         for r in spec.return_ids():
             self.memory_store.put_error(r, error)
+        if spec.num_returns == -2:
+            stream = self._gen_streams.get(spec.task_id)
+            if stream is not None:
+                stream.finalize(error=error)
+                if stream.cancelled:
+                    self._gen_streams.pop(spec.task_id, None)
 
     async def _conn_to(self, addr: str) -> rpc.Connection:
         conn = self._worker_conns.get(addr)
@@ -1227,6 +1417,14 @@ class CoreWorker:
         self._pending_tasks[spec.task_id] = {
             "spec": spec, "retries_left": 0, "pinned": pinned or [],
         }
+        if num_returns == -2:
+            from ray_tpu._private.object_ref import (
+                StreamingObjectRefGenerator,
+            )
+
+            stream = _GeneratorStream(self, spec)
+            self._gen_streams[spec.task_id] = stream
+            refs = [StreamingObjectRefGenerator(stream, refs[0])]
         self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
         self.io.submit(self._enqueue_actor_task(spec))
         return refs
@@ -1531,7 +1729,9 @@ class CoreWorker:
                 try:
                     method = getattr(self._actor_instance, spec.method_name)
                     args, kwargs = self._unpack_args(self._decode_args(spec))
-                    if inspect.iscoroutinefunction(method):
+                    if inspect.isasyncgenfunction(method):
+                        result = method(*args, **kwargs)  # async generator
+                    elif inspect.iscoroutinefunction(method):
                         result = await method(*args, **kwargs)
                     else:
                         # sync method of an async actor: off the loop so
@@ -1539,7 +1739,18 @@ class CoreWorker:
                         result = await asyncio.to_thread(
                             method, *args, **kwargs
                         )
-                    out = self._encode_returns(spec, result)
+                    if spec.num_returns == -2:
+                        # streaming: never block this loop on report acks
+                        if inspect.isasyncgen(result):
+                            out = await self._stream_async_generator_returns(
+                                spec, result
+                            )
+                        else:
+                            out = await asyncio.to_thread(
+                                self._stream_generator_returns, spec, result
+                            )
+                    else:
+                        out = self._encode_returns(spec, result)
                     self._emit_task_event(spec, "FINISHED")
                     return out
                 except Exception as e:  # noqa: BLE001 — shipped to caller
@@ -1706,6 +1917,7 @@ class CoreWorker:
 
                 self._actor_is_async = any(
                     _inspect.iscoroutinefunction(m)
+                    or _inspect.isasyncgenfunction(m)
                     for _, m in _inspect.getmembers(type(self._actor_instance))
                 )
                 if self._actor_concurrency > 1 and not self._actor_is_async:
@@ -1756,7 +1968,7 @@ class CoreWorker:
                     )
                 )
             )
-        n = 1 if spec.num_returns == -1 else spec.num_returns
+        n = 1 if spec.num_returns in (-1, -2) else spec.num_returns
         returns = [["v", packed] for _ in range(n)]
         return {"returns": returns, "error": str(e)}
 
@@ -1767,7 +1979,105 @@ class CoreWorker:
             return decoded[:-1], decoded[-1].kwargs
         return decoded, {}
 
+    # ---- streaming generator execution (parity: reference streaming
+    # generator returns, core_worker.proto ReportGeneratorItemReturns;
+    # the CALLER owns every yield — see rpc_report_generator_item) ----
+
+    def _encode_yield(self, spec: TaskSpec, index: int, item) -> Dict:
+        """Pack one yield: big values go into the local store under the
+        deterministic yield id; small ones ride in the report RPC."""
+        from ray_tpu._private.object_store import ObjectExistsError
+        from ray_tpu._private.protocol import yield_object_id
+
+        oid = yield_object_id(spec.tid, index)
+        meta, views, total = serialization.packed_size(item)
+        if serialization.take_contained_refs():
+            # No containment-edge shipping on the report path yet: failing
+            # loudly beats a silent borrow leak (the inner object could be
+            # freed under the consumer).
+            raise TypeError(
+                "streaming generators cannot yield values containing "
+                "ObjectRefs (yield the value itself, or use "
+                "num_returns='dynamic')"
+            )
+        if total > GLOBAL_CONFIG.inline_object_max_bytes:
+            try:
+                buf = self._create_with_spill(oid, total)
+            except ObjectExistsError:
+                # re-execution on the same node: bytes already sealed
+                self.gcs.call("add_object_location",
+                              [oid.binary(), self.node_id])
+                return {"task_id": spec.task_id, "index": index,
+                        "kind": "p", "node": self.node_id}
+            try:
+                serialization.pack_into(meta, views, buf)
+            finally:
+                del buf
+            self.store.seal(oid)
+            self.store.release(oid)
+            self.gcs.call("add_object_location", [oid.binary(), self.node_id])
+            return {"task_id": spec.task_id, "index": index,
+                    "kind": "p", "node": self.node_id}
+        out = bytearray(total)
+        serialization.pack_into(meta, views, memoryview(out))
+        return {"task_id": spec.task_id, "index": index,
+                "kind": "v", "payload": bytes(out)}
+
+    async def _send_gen_report(self, owner_wire, msg: Dict) -> Dict:
+        conn = await self._conn_to(owner_wire[1])
+        # no timeout: the caller delays the reply as backpressure
+        return await conn.call_async("report_generator_item", msg,
+                                     timeout=None)
+
+    def _stream_generator_returns(self, spec: TaskSpec, result) -> Dict:
+        """Drive a (sync) generator, reporting each yield to the caller and
+        blocking this executing thread on the caller's ack — the ack delay
+        is the backpressure. Runs on the execution thread, never the IO
+        loop."""
+        import inspect
+
+        if not inspect.isgenerator(result) and not hasattr(
+            result, "__iter__"
+        ):
+            raise TypeError(
+                f"num_returns='streaming' task {spec.name} must return a "
+                f"generator/iterable, got {type(result).__name__}"
+            )
+        n = 0
+        for item in result:
+            msg = self._encode_yield(spec, n, item)
+            fut = asyncio.run_coroutine_threadsafe(
+                self._send_gen_report(spec.owner, msg), self.io.loop
+            )
+            reply = fut.result()
+            if not reply.get("ok"):
+                break  # caller gone: stop generating
+            n += 1
+        count_packed = serialization.pack(n)
+        serialization.take_contained_refs()
+        return {"returns": [["v", count_packed]], "num_yields": n}
+
+    async def _stream_async_generator_returns(self, spec: TaskSpec,
+                                              agen) -> Dict:
+        """Async-generator variant (async actor methods): awaits the report
+        ack without blocking the actor's asyncio loop."""
+        n = 0
+        async for item in agen:
+            msg = self._encode_yield(spec, n, item)
+            fut = asyncio.run_coroutine_threadsafe(
+                self._send_gen_report(spec.owner, msg), self.io.loop
+            )
+            reply = await asyncio.wrap_future(fut)
+            if not reply.get("ok"):
+                break
+            n += 1
+        count_packed = serialization.pack(n)
+        serialization.take_contained_refs()
+        return {"returns": [["v", count_packed]], "num_yields": n}
+
     def _encode_returns(self, spec: TaskSpec, result) -> Dict:
+        if spec.num_returns == -2:
+            return self._stream_generator_returns(spec, result)
         if spec.num_returns == 0:
             return {"returns": []}
         if spec.num_returns == -1:

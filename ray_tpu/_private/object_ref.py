@@ -109,9 +109,8 @@ def install_ref_hooks(on_created, on_deleted):
 class ObjectRefGenerator:
     """Result of a ``num_returns="dynamic"`` generator task: an iterable of
     the ObjectRefs created from the task's yields (parity: reference
-    DynamicObjectRefGenerator / _raylet.pyx:237 streaming generators —
-    here the eager 'dynamic' variant: refs exist once the task finishes).
-    """
+    DynamicObjectRefGenerator — the eager variant: refs exist once the
+    task finishes; the executor owns the yields)."""
 
     def __init__(self, refs):
         self._refs = list(refs)
@@ -127,3 +126,62 @@ class ObjectRefGenerator:
 
     def __repr__(self):
         return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
+class StreamingObjectRefGenerator:
+    """Result of a ``num_returns="streaming"`` generator task (parity:
+    reference StreamingObjectRefGenerator, _raylet.pyx:237): yields
+    CALLER-OWNED ObjectRefs as the executing task reports them — before
+    the task finishes. The caller owning the yields means lineage covers
+    them: if the executing worker dies mid-generation, the task is
+    re-executed and the stream resumes past what was already consumed.
+
+    Iterating blocks until the next item is reported (or the stream ends /
+    errors). Not picklable — consume it in the process that created it
+    (reference semantics)."""
+
+    def __init__(self, stream, completion_ref: "ObjectRef"):
+        self._stream = stream  # core_worker._GeneratorStream
+        self._completion_ref = completion_ref
+
+    @property
+    def completion_ref(self) -> "ObjectRef":
+        """Ref resolving to the total yield count when the task finishes
+        (or raising the task's error)."""
+        return self._completion_ref
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        ref = self._stream.next_ref()
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def next_with_timeout(self, timeout: float):
+        """Like ``next()`` but raises TimeoutError if no item is reported
+        within ``timeout`` seconds (None item = end of stream)."""
+        return self._stream.next_ref(timeout=timeout)
+
+    def close(self):
+        """Abandon the stream: the executing generator is NACKed at its
+        next yield report and stops. Idempotent; called automatically when
+        the handle is garbage-collected so a dropped half-consumed stream
+        can't park the executor (and its worker lease) forever."""
+        self._stream.cancel()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "StreamingObjectRefGenerator is not picklable: consume it in "
+            "the process that called .remote() (reference parity)"
+        )
+
+    def __repr__(self):
+        return f"StreamingObjectRefGenerator({self._stream!r})"

@@ -73,9 +73,21 @@ class TaskSpec:
 
     def return_ids(self) -> List[ObjectID]:
         # num_returns == -1 ("dynamic" generator task): ONE return whose
-        # value is an ObjectRefGenerator over the yielded objects
-        n = 1 if self.num_returns == -1 else self.num_returns
+        # value is an ObjectRefGenerator over the yielded objects.
+        # num_returns == -2 ("streaming" generator task): ONE return — the
+        # completion object (yield count / error); the yields themselves
+        # get deterministic ids via yield_object_id().
+        n = 1 if self.num_returns in (-1, -2) else self.num_returns
         return [ObjectID.from_task(self.tid, i + 1) for i in range(n)]
+
+
+def yield_object_id(tid: "TaskID", index: int) -> ObjectID:
+    """Deterministic id of a streaming generator task's ``index``-th yield
+    (parity: reference streaming-generator return ids, _raylet.pyx:237):
+    return slot 1 is the completion object, yields occupy slots 2+.
+    Determinism is what makes re-execution after a worker death land the
+    same objects under the same refs."""
+    return ObjectID.from_task(tid, index + 2)
 
 
 @dataclasses.dataclass

@@ -20,12 +20,17 @@ from ray_tpu.remote_function import (
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
-        if not isinstance(num_returns, int) or isinstance(
+        # "streaming" -> -2: caller-owned streaming generator method
+        # (reference streaming generators work on actors too)
+        if num_returns == "streaming":
+            num_returns = -2
+        elif not isinstance(num_returns, int) or isinstance(
             num_returns, bool
-        ) or num_returns < 0:
+        ) or (num_returns < 0 and num_returns != -2):
             raise ValueError(
-                "actor methods take a non-negative int num_returns "
-                f"(got {num_returns!r}; 'dynamic' generators are task-only)"
+                "actor methods take a non-negative int num_returns or "
+                f"'streaming' (got {num_returns!r}; eager 'dynamic' "
+                "generators are task-only)"
             )
         self._handle = handle
         self._name = name
@@ -59,7 +64,7 @@ class ActorMethod:
             ),
             pinned=pinned,
         )
-        if self._num_returns == 1:
+        if self._num_returns in (1, -2):
             return refs[0]
         return refs
 
@@ -89,7 +94,8 @@ class ActorHandle:
 
 
 def _method_meta_of(cls) -> Dict[str, int]:
-    """num_returns per method, collected from @ray_tpu.method markers."""
+    """num_returns per method, collected from @ray_tpu.method markers.
+    "streaming" normalizes to -2 (caller-owned streaming generator)."""
     meta = {}
     for name in dir(cls):
         if name.startswith("_"):
@@ -97,7 +103,7 @@ def _method_meta_of(cls) -> Dict[str, int]:
         fn = getattr(cls, name, None)
         n = getattr(fn, "__ray_num_returns__", None)
         if n is not None:
-            meta[name] = int(n)
+            meta[name] = -2 if n == "streaming" else int(n)
     return meta
 
 

@@ -63,18 +63,22 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             pinned=pinned,
         )
-        if opts.get("num_returns", 1) in (1, "dynamic"):
+        if opts.get("num_returns", 1) in (1, "dynamic", "streaming"):
             return refs[0]
         return refs
 
 
 def _normalize_num_returns(nr):
-    """'dynamic' -> -1 (generator task); otherwise a non-negative int."""
+    """'dynamic' -> -1 (eager generator task); 'streaming' -> -2
+    (caller-owned streaming generator); otherwise a non-negative int."""
     if nr == "dynamic":
         return -1
+    if nr == "streaming":
+        return -2
     if not isinstance(nr, int) or isinstance(nr, bool) or nr < 0:
         raise ValueError(
-            f"num_returns must be a non-negative int or 'dynamic', got {nr!r}"
+            "num_returns must be a non-negative int, 'dynamic' or "
+            f"'streaming', got {nr!r}"
         )
     return nr
 
