@@ -134,6 +134,200 @@ def decode_step(params, token, cache, pos, config: TransformerConfig):
     return logits[:, 0, :], cache
 
 
+# ---------------- continuous-batching primitives ----------------
+# (serve/llm.py's iteration-level scheduler: per-SLOT positions so one
+# compiled decode step serves sequences admitted at different times —
+# the TPU-shaped analog of vLLM's iteration-level batching.)
+
+
+def _attend_cached_multi(q, cache_k, cache_v, q_pos, kv_valid):
+    """q [B,1,H,D] against cache [B,S_max,Hkv,D] with PER-SLOT positions:
+    q_pos [B] (each slot's absolute position), kv_valid [B,S_max]."""
+    n_rep = q.shape[2] // cache_k.shape[2]
+    k = repeat_kv(cache_k, n_rep)
+    v = repeat_kv(cache_v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = jnp.arange(k.shape[1])
+    mask = (q_pos[:, None] >= k_pos[None, :]) & kv_valid  # [B, S_max]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _decode_forward_multi(params, token, cache, pos,
+                          config: TransformerConfig):
+    """Core of the per-slot decode step (tokens [B] at per-slot positions
+    pos [B]); shared by decode_step_multi and the scanned decode_block."""
+    c = config
+    B = token.shape[0]
+    x = params["embed"].astype(c.dtype)[token][:, None]  # [B,1,D]
+    s_max = cache["k"].shape[2]
+    kv_valid = jnp.arange(s_max)[None, :] <= pos[:, None]  # [B,S_max]
+    b_idx = jnp.arange(B)
+
+    def layer(carry, layer_in):
+        x, ck_all, cv_all = carry
+        lp, li = layer_in
+
+        def cached_attn(q, k, v):
+            # scatter each slot's k/v at its own position
+            ck2 = ck_all.at[li, b_idx, pos].set(
+                k[:, 0].astype(ck_all.dtype)
+            )
+            cv2 = cv_all.at[li, b_idx, pos].set(
+                v[:, 0].astype(cv_all.dtype)
+            )
+            ck = lax.dynamic_index_in_dim(ck2, li, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cv2, li, 0, keepdims=False)
+            return _attend_cached_multi(q, ck, cv, pos, kv_valid), (ck2, cv2)
+
+        y, _aux, (ck_all, cv_all) = apply_layer(
+            x, lp, c, pos[:, None], cached_attn
+        )
+        return (y, ck_all, cv_all), None
+
+    (x, new_k, new_v), _ = lax.scan(
+        layer,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(c.n_layers)),
+    )
+    x = _rms_norm(x, params["final_ln"]["scale"])
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(c.dtype))
+    return logits[:, 0, :], {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def decode_step_multi(params, token, cache, pos, config: TransformerConfig):
+    """One token per SLOT at per-slot absolute positions.
+
+    token [B] int32, pos [B] int32 (position each slot's token occupies).
+    Inactive slots simply decode garbage into their own lane — they attend
+    only their own cache row, so active slots are unaffected; the engine
+    ignores their outputs. Returns (logits [B, V], cache)."""
+    return _decode_forward_multi(params, token, cache, pos, config)
+
+
+def _sample_vec(logits, temps, seeds, counts):
+    """Per-slot on-device sampling: greedy where temps==0, Gumbel-max
+    categorical elsewhere, deterministic per (seed, count)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def one(lg, t, s, c):
+        key = jax.random.fold_in(jax.random.key(s), c)
+        g = jax.random.gumbel(key, lg.shape, jnp.float32)
+        return jnp.argmax(
+            lg.astype(jnp.float32) / jnp.maximum(t, 1e-6) + g
+        ).astype(jnp.int32)
+
+    sampled = jax.vmap(one)(logits, temps, seeds, counts)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+@partial(jax.jit, static_argnames=("config", "steps"), donate_argnums=(1,))
+def decode_block(params, cache, token, pos, temps, seeds, counts,
+                 config: TransformerConfig, steps: int):
+    """``steps`` decode iterations as ONE compiled program with on-device
+    per-slot sampling — the serving engine's unit of work. One host
+    transfer ([B, steps] int32 tokens) per block instead of per token:
+    essential when the host<->device link has real latency (remote-TPU
+    tunnel; same trick as decode_loop, but with per-slot positions so
+    slots admitted at different times share the batch).
+
+    Returns (tokens [B, steps], cache, token', pos', counts')."""
+    def step(carry, _):
+        tok, cache, pos, counts = carry
+        logits, cache = _decode_forward_multi(params, tok, cache, pos,
+                                              config)
+        nxt = _sample_vec(logits, temps, seeds, counts)
+        return (nxt, cache, pos + 1, counts + 1), nxt
+
+    (token, cache, pos, counts), toks = lax.scan(
+        step, (token, cache, pos, counts), None, length=steps
+    )
+    return toks.T, cache, token, pos, counts
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(4,))
+def prefill_into_slot(params, prompt, prompt_len, slot, cache,
+                      config: TransformerConfig):
+    """Run ONE padded prompt [1, Sb] and write its K/V into ``slot`` of the
+    shared batch cache (static shapes: Sb is a bucket size; compile count =
+    number of buckets). Positions past prompt_len write junk K/V that is
+    never attended: the slot's kv_valid mask stops at its position, and
+    decode overwrites those cells before reaching them.
+
+    Returns (last-valid-token logits [V], cache)."""
+    c = config
+    single = {
+        "k": jnp.zeros_like(cache["k"][:, :1]),
+        "v": jnp.zeros_like(cache["v"][:, :1]),
+    }
+    s_max = cache["k"].shape[2]
+    S = prompt.shape[1]
+    x = params["embed"].astype(c.dtype)[prompt]
+    positions = jnp.arange(S)
+    kv_valid = (jnp.arange(s_max) < prompt_len)[None]  # [1, S_max]
+
+    def layer(carry, layer_in):
+        x, ck_all, cv_all = carry
+        lp, li = layer_in
+
+        def cached_attn(q, k, v):
+            ck2 = lax.dynamic_update_slice(
+                ck_all, k[None].astype(ck_all.dtype), (li, 0, 0, 0, 0)
+            )
+            cv2 = lax.dynamic_update_slice(
+                cv_all, v[None].astype(cv_all.dtype), (li, 0, 0, 0, 0)
+            )
+            ck = lax.dynamic_index_in_dim(ck2, li, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cv2, li, 0, keepdims=False)
+            return _attend_prefill(q, ck, cv, positions, kv_valid), (
+                ck2, cv2
+            )
+
+        y, _aux, (ck_all, cv_all) = apply_layer(
+            x, lp, c, positions, cached_attn
+        )
+        return (y, ck_all, cv_all), None
+
+    def _attend_prefill(q, ck, cv, q_pos, kv_valid_b):
+        n_rep = q.shape[2] // ck.shape[2]
+        k = repeat_kv(ck, n_rep)
+        v = repeat_kv(cv, n_rep)
+        scale = q.shape[-1] ** -0.5
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        k_pos = jnp.arange(k.shape[1])
+        mask = (q_pos[:, None] >= k_pos[None, :])[None] & (
+            kv_valid_b[:, None, :]
+        )
+        scores = jnp.where(mask[:, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    (x, single_k, single_v), _ = lax.scan(
+        layer,
+        (x, single["k"], single["v"]),
+        (params["layers"], jnp.arange(c.n_layers)),
+    )
+    x = _rms_norm(x, params["final_ln"]["scale"])
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    last = x[0, prompt_len - 1]  # [D] — last REAL token's features
+    logits = last @ head.astype(c.dtype)
+    new_k = lax.dynamic_update_slice(
+        cache["k"], single_k, (0, slot, 0, 0, 0)
+    )
+    new_v = lax.dynamic_update_slice(
+        cache["v"], single_v, (0, slot, 0, 0, 0)
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
 def _sample(logits, rng, temperature: float):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -209,13 +209,21 @@ def _rms_norm(x, scale, eps=1e-6):
 def _rotary(q, k, rotary_dim, positions):
     """Apply rotary embeddings to the first `rotary_dim` dims of q/k.
 
-    q/k: [B, S, H, D]; positions: [S] global token positions.
+    q/k: [B, S, H, D]; positions: [S] global token positions, or [B, S]
+    per-sequence positions (continuous-batching decode, where slots sit at
+    different depths).
     """
     d2 = rotary_dim // 2
     inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, d2) / d2))
-    freqs = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # [S,d2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    freqs = (
+        positions[..., None].astype(jnp.float32) * inv_freq
+    )  # [S,d2] or [B,S,d2]
+    if positions.ndim == 1:
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+    else:
+        cos = jnp.cos(freqs)[:, :, None, :]
+        sin = jnp.sin(freqs)[:, :, None, :]
 
     def rot(x):
         xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]

@@ -149,7 +149,17 @@ def start_http_proxy(port: int = 0) -> str:
     return ray_tpu.get(_proxy.address.remote(), timeout=60)
 
 
+def __getattr__(name):
+    # lazy: serve.LLMEngine / serve.LLMServer pull in jax only when used
+    if name in ("LLMEngine", "LLMServer"):
+        from ray_tpu.serve import llm
+
+        return getattr(llm, name)
+    raise AttributeError(name)
+
+
 __all__ = [
     "deployment", "run", "delete", "status", "get_deployment_handle",
     "start_http_proxy", "Deployment", "Application", "DeploymentHandle",
+    "LLMEngine", "LLMServer",
 ]

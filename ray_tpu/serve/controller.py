@@ -44,6 +44,19 @@ class Replica:
             )
         return list(out)
 
+    def handle_stream(self, args, kwargs):
+        """Streaming request (called with num_returns='streaming'): chunks
+        flow to the caller as the deployment produces them (parity:
+        reference replica.py:325 streaming responses). Prefers the user
+        object's ``stream`` method; otherwise calls it and streams a
+        generator result (or yields a single value once)."""
+        fn = getattr(self._callable, "stream", None) or self._callable
+        result = fn(*args, **(kwargs or {}))
+        if hasattr(result, "__next__"):
+            yield from result
+        else:
+            yield result
+
     def reconfigure(self, user_config):
         fn = getattr(self._callable, "reconfigure", None)
         if fn is not None:

@@ -198,6 +198,22 @@ class Router:
         self._report_load()  # after registration: the request is visible
         return _ResultFuture(ref, self._release_ref, recover_and_resend)
 
+    # -- streaming path --
+
+    def submit_stream(self, args, kwargs):
+        """Route a streaming request: returns an iterator of response
+        chunks, produced as the replica yields them (rides the
+        caller-owned streaming generator protocol)."""
+        self._refresh()
+        self._reap_inflight()
+        self._ensure_reporter()
+        idx, replica = self._pick()
+        gen = replica.handle_stream.options(
+            num_returns="streaming"
+        ).remote(list(args), dict(kwargs or {}))
+        self._report_load()
+        return _StreamIterator(gen, lambda: self._release(idx))
+
     # -- batched path --
 
     def _submit_batched(self, args, kwargs):
@@ -308,6 +324,52 @@ class _ResultFuture:
             self._release_ref(self._ref)
 
 
+class _StreamIterator:
+    """Iterates a replica's streaming response, yielding chunk VALUES.
+    Closing (or abandoning) it cancels the underlying stream so the
+    replica's generator stops."""
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu as _rt
+
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        except BaseException:
+            self._finish()
+            raise
+        return _rt.get(ref)
+
+    def close(self):
+        if not self._done:
+            self._gen.close()
+            self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            try:
+                self._release()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class _LocalFuture:
     def __init__(self, req: _PendingRequest):
         self._req = req
@@ -336,6 +398,12 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         """Submit a request; returns a future with .result(timeout)."""
         return self._get_router().submit(args, kwargs)
+
+    def stream(self, *args, **kwargs):
+        """Submit a STREAMING request; returns an iterator of chunks
+        (parity: reference handle.options(stream=True)). The deployment's
+        ``stream`` method (or a generator ``__call__``) produces them."""
+        return self._get_router().submit_stream(args, kwargs)
 
     def __reduce__(self):
         return (DeploymentHandle, (self._controller, self._deployment))

@@ -25,16 +25,26 @@ class HTTPProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer-encoding requires HTTP/1.1 on the status
+            # line — spec-compliant clients read an HTTP/1.0 body to EOF
+            # and would see the raw chunk framing
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
             def do_POST(self):
-                name = self.path.strip("/").split("/")[0]
+                parts = self.path.strip("/").split("/")
+                name = parts[0]
+                streaming = len(parts) > 1 and parts[1] == "stream"
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length)
                     payload = json.loads(body) if body else None
                     handle = proxy._handle_for(name)
+                    if streaming:
+                        self._stream_response(handle, payload)
+                        return
                     result = handle.remote(payload).result(timeout=120)
                     out = json.dumps({"result": result}).encode()
                     self.send_response(200)
@@ -50,6 +60,34 @@ class HTTPProxy:
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
+
+            def _stream_response(self, handle, payload):
+                """POST /<name>/stream — chunked JSON-lines response: each
+                chunk the deployment yields is written (and flushed) as it
+                arrives (parity: reference ASGI streaming responses,
+                http_proxy.py)."""
+                it = handle.stream(payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):X}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for item in it:
+                        chunk(json.dumps({"chunk": item}).encode() + b"\n")
+                except Exception as e:  # noqa: BLE001 — surfaced in-band
+                    chunk(json.dumps({"error": str(e)}).encode() + b"\n")
+                finally:
+                    close = getattr(it, "close", None)
+                    if close:
+                        close()
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
 
             do_GET = do_POST
 

@@ -1,0 +1,248 @@
+"""Serve streaming + iteration-level continuous batching tests.
+
+Parity surfaces: reference ``serve/_private/replica.py:325`` (streaming
+responses), ``http_proxy.py`` (ASGI streaming), and the
+continuous-batching serving shape the BASELINE north star (Llama-class
+p50 TTFT under load) demands: a request arriving mid-decode gets its
+first token after ~one step + prefill, not after a batch completes.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _tiny_model():
+    import jax
+
+    from ray_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    return init_params(cfg, jax.random.key(0)), cfg
+
+
+# ---------------- engine-level (no cluster) ----------------
+
+
+def test_engine_matches_generate():
+    """Continuous-batching decode must reproduce the plain generate()
+    output for interleaved greedy requests."""
+    import jax
+
+    from ray_tpu.models.generation import generate, prepare_for_inference
+    from ray_tpu.serve.llm import LLMEngine
+
+    params, cfg = _tiny_model()
+    prompts = [
+        np.arange(1, 9, dtype=np.int32),
+        (np.arange(3, 15, dtype=np.int32) % cfg.vocab_size).astype(np.int32),
+        np.full(5, 7, np.int32),
+    ]
+    ip, icfg = prepare_for_inference(params, cfg)
+    ref = [
+        np.asarray(
+            generate(ip, p[None], icfg, max_new_tokens=10, max_len=64)
+        )[0]
+        for p in prompts
+    ]
+    eng = LLMEngine(params, cfg, max_slots=2, max_len=64,
+                    prefill_buckets=(16, 32))
+    try:
+        res = [None] * len(prompts)
+
+        def run(i):
+            res[i] = eng.generate(prompts[i], max_new_tokens=10)
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        for i in range(len(prompts)):
+            assert res[i] == ref[i].tolist(), (i, res[i], ref[i].tolist())
+    finally:
+        eng.shutdown()
+
+
+def test_engine_mid_decode_admission_ttft():
+    """VERDICT round-3 criterion: a request arriving mid-decode gets its
+    first token in ~one iteration, not after the running request ends."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    params, cfg = _tiny_model()
+    eng = LLMEngine(params, cfg, max_slots=4, max_len=128,
+                    prefill_buckets=(16,))
+    try:
+        # A: long-running generation
+        a = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=100)
+        # wait until A is decoding
+        for _ in range(200):
+            if a.produced >= 5:
+                break
+            time.sleep(0.02)
+        assert a.produced >= 5
+        # B arrives mid-decode
+        t0 = time.monotonic()
+        first_b = next(eng.generate_stream(
+            np.arange(2, 8, dtype=np.int32), max_new_tokens=4
+        ))
+        ttft_b = time.monotonic() - t0
+        a_done_after_b = a.produced
+        assert isinstance(first_b, int)
+        # B's first token arrived while A was still mid-generation
+        assert a_done_after_b < 100, "A finished before B started: no overlap"
+        # and quickly: a handful of decode steps, not A's remaining tail
+        assert ttft_b < 5.0, ttft_b
+    finally:
+        eng.shutdown()
+
+
+# ---------------- serve-level ----------------
+
+
+def test_streaming_deployment_chunks_arrive_early(rt):
+    @serve.deployment(num_replicas=1,
+                      ray_actor_options={"max_concurrency": 4})
+    class Chunky:
+        def stream(self, n):
+            for i in range(n):
+                yield f"chunk{i}"
+                time.sleep(0.3)
+
+        def __call__(self, n):
+            return n
+
+    handle = serve.run(Chunky.bind())
+    it = handle.stream(4)
+    t0 = time.monotonic()
+    first = next(it)
+    dt = time.monotonic() - t0
+    assert first == "chunk0"
+    assert dt < 1.0, f"first chunk waited for the whole stream ({dt:.1f}s)"
+    assert list(it) == ["chunk1", "chunk2", "chunk3"]
+    serve.delete("Chunky")
+
+
+def test_llm_deployment_streams_tokens(rt):
+    def tiny_model():  # local def: pickled by value into the replica
+        import jax
+
+        from ray_tpu.models.transformer import TransformerConfig, init_params
+
+        cfg = TransformerConfig.tiny()
+        return init_params(cfg, jax.random.key(0)), cfg
+
+    @serve.deployment(num_replicas=1,
+                      ray_actor_options={"max_concurrency": 8})
+    class TinyLLM(serve.LLMServer):
+        def __init__(self):
+            super().__init__(tiny_model, max_slots=2, max_len=64,
+                             prefill_buckets=(16,))
+
+    handle = serve.run(TinyLLM.bind())
+    prompt = list(range(1, 9))
+    toks = list(handle.stream(prompt, 8))
+    assert len(toks) == 8
+    assert all(isinstance(t, int) for t in toks)
+    # blocking path returns the same ids (greedy determinism)
+    full = handle.remote(prompt, 8).result(timeout=120)
+    assert full == toks
+    serve.delete("TinyLLM")
+
+
+def test_http_proxy_chunked_streaming(rt):
+    @serve.deployment(num_replicas=1,
+                      ray_actor_options={"max_concurrency": 4})
+    class S:
+        def stream(self, n):
+            for i in range(n):
+                yield i * 11
+                time.sleep(0.05)
+
+        def __call__(self, n):
+            return n
+
+    serve.run(S.bind())
+    base = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"{base}/S/stream", data=json.dumps(3).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        lines = [json.loads(ln) for ln in resp if ln.strip()]
+    assert [d["chunk"] for d in lines] == [0, 11, 22]
+    serve.delete("S")
+
+
+def test_decode_step_multi_matches_block():
+    """The single-step primitive and the scanned block agree (greedy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generation import (
+        decode_block,
+        decode_step_multi,
+        init_kv_cache,
+        prefill_into_slot,
+        prepare_for_inference,
+    )
+
+    params, cfg = _tiny_model()
+    params, icfg = prepare_for_inference(params, cfg)
+    prompt = jnp.arange(1, 9, dtype=jnp.int32)[None]
+
+    def prefilled():
+        cache = init_kv_cache(icfg, 2, 32)
+        logits, cache = prefill_into_slot(
+            params, prompt, jnp.int32(8), jnp.int32(0), cache, icfg
+        )
+        first = jnp.argmax(logits).astype(jnp.int32)
+        tok = jnp.zeros(2, jnp.int32).at[0].set(first)
+        pos = jnp.zeros(2, jnp.int32).at[0].set(8)
+        return tok, pos, cache
+
+    tok, pos, cache = prefilled()
+    logits, _cache = decode_step_multi(params, tok, cache, pos, icfg)
+    step_next = int(jnp.argmax(logits[0]))
+
+    tok, pos, cache = prefilled()
+    zeros = jnp.zeros(2, jnp.float32)
+    izeros = jnp.zeros(2, jnp.int32)
+    toks, *_ = decode_block(params, cache, tok, pos, zeros, izeros, izeros,
+                            icfg, 1)
+    assert int(toks[0, 0]) == step_next
+
+
+def test_engine_failure_unblocks_consumers():
+    """A device error inside the engine loop must fail live streams, not
+    hang them."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    params, cfg = _tiny_model()
+    eng = LLMEngine(params, cfg, max_slots=2, max_len=64,
+                    prefill_buckets=(16,))
+    # sabotage the decode path to simulate a device failure
+    eng._dispatch_block = lambda: (_ for _ in ()).throw(
+        RuntimeError("device fell over")
+    )
+    with pytest.raises(RuntimeError, match="device fell over|not running"):
+        list(eng.generate_stream(np.arange(4, dtype=np.int32),
+                                 max_new_tokens=4))
+    # engine is dead: new submissions are refused, not silently queued
+    with pytest.raises(RuntimeError, match="not running"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
