@@ -97,6 +97,11 @@ _d("worker_pool_min_idle", int, 0)
 _d("scheduler_spread_threshold", float, 0.5)
 _d("infeasible_task_grace_s", float, 30.0)
 _d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
+# outbound chunk-serve concurrency per raylet (push-manager pacing role)
+_d("object_transfer_max_concurrent_chunks", int, 4)
+# how many tasks an owner keeps in flight per lease (arg staging overlaps:
+# a slow-transfer task doesn't stall the lease pipeline)
+_d("lease_push_pipeline_depth", int, 2)
 _d("memory_monitor_refresh_ms", int, 250)
 _d("memory_usage_threshold", float, 0.95)
 _d("event_stats_enabled", bool, True)

@@ -116,6 +116,14 @@ class Raylet:
         self.spilled_bytes = 0
         self._spilling: Set[bytes] = set()  # oids with an in-flight spill
         self._ever_workers: Set[bytes] = set()  # for log tailing after death
+        # object-plane transfer management (dependency-manager round):
+        # in-flight inbound pulls (dedup) + outbound chunk pacing + counters
+        self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._outbound_sem = asyncio.Semaphore(
+            int(GLOBAL_CONFIG.object_transfer_max_concurrent_chunks)
+        )
+        self._outbound_chunks = 0
+        self._objects_served = 0
         # live actors hosted here: actor_id -> {"spec", "address"} — replayed
         # to a restarted GCS so its actor table survives (GCS FT)
         self.hosted_actors: Dict[bytes, Dict] = {}
@@ -1247,11 +1255,11 @@ class Raylet:
 
     # ------------- object plane -------------
     async def rpc_pull_object(self, conn, oid_bytes: bytes):
-        """Ensure the object is in the local store (fetch from a remote node).
-
-        Single-node: just report presence. Multi-node transfer lands with the
-        cluster milestone (chunked raylet-to-raylet pulls).
-        """
+        """Ensure the object is in the local store (fetch from a remote
+        node). Concurrent pulls of the SAME object are deduplicated into
+        one in-flight fetch (parity: reference PullManager admission,
+        pull_manager.h:52) — N workers asking for one hot object cost one
+        transfer, not N."""
         from ray_tpu._private.ids import ObjectID
 
         oid = ObjectID(oid_bytes)
@@ -1259,7 +1267,32 @@ class Raylet:
             return True
         if await self._restore_object(oid):  # spilled here: restore from disk
             return True
+        inflight = self._pulls_inflight.get(oid_bytes)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[oid_bytes] = fut
+        try:
+            ok = await self._pull_object_once(oid, oid_bytes)
+            if not fut.done():
+                fut.set_result(ok)
+            return ok
+        except BaseException:
+            if not fut.done():
+                fut.set_result(False)
+            raise
+        finally:
+            self._pulls_inflight.pop(oid_bytes, None)
+
+    async def _pull_object_once(self, oid, oid_bytes: bytes) -> bool:
+        import random as _random
+
         locs = await self.gcs.call_async("get_object_locations", oid_bytes)
+        locs = list(locs)
+        # randomize the source so an N-node broadcast forms a tree (each
+        # completed pull registers a new location) instead of every node
+        # hammering the origin (reference push_manager.h:30 role)
+        _random.shuffle(locs)
         for node_id in locs:
             nid_hex = bytes(node_id).hex()
             if nid_hex == self.node_id.hex():
@@ -1327,6 +1360,7 @@ class Raylet:
         size = view.nbytes
         view.release()
         self.store.release(ObjectID(oid_bytes))
+        self._objects_served += 1
         return {"size": size}
 
     async def rpc_read_object_chunk(self, conn, data):
@@ -1334,13 +1368,22 @@ class Raylet:
 
         oid_bytes, off, n = data
         oid = ObjectID(oid_bytes)
+        # a spilled object restores BEFORE pacing: a multi-second disk
+        # restore must not occupy an outbound slot and stall every other
+        # node's in-memory pulls
         view = self.store.get(oid, timeout=0)
         if view is None and await self._restore_object(oid):
             view = self.store.get(oid, timeout=0)
         if view is None:
             return None
         try:
-            return bytes(view[off : off + n])
+            # chunk-granular pacing: bound concurrent outbound reads so N
+            # simultaneous pullers interleave fairly instead of thrashing
+            # the source (parity: reference PushManager chunk pacing,
+            # push_manager.h:30)
+            async with self._outbound_sem:
+                self._outbound_chunks += 1
+                return bytes(view[off : off + n])
         finally:
             view.release()
             self.store.release(oid)
@@ -1356,6 +1399,8 @@ class Raylet:
             "num_leases": len(self.leases),
             "queue_len": len(self.lease_queue),
             "demand": self._queued_demand(),
+            "objects_served": self._objects_served,
+            "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
         }
 
