@@ -20,6 +20,10 @@ Public surface (parity: ``ray.train`` / ``ray.air``):
 
 from ray_tpu.train import session  # noqa: F401
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.sharded_checkpoint import (  # noqa: F401
+    load_sharded,
+    save_sharded,
+)
 from ray_tpu.train.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,
@@ -44,5 +48,7 @@ __all__ = [
     "CheckpointManager",
     "Result",
     "TrainingFailedError",
+    "save_sharded",
+    "load_sharded",
     "session",
 ]

@@ -118,10 +118,32 @@ class CheckpointManager:
         return val if self.config.checkpoint_score_order == "max" else -val
 
     def register(self, checkpoint: Checkpoint, metrics: Dict) -> Checkpoint:
-        """Persist + score a reported checkpoint; returns the dir-backed one."""
-        path = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
-        self._index += 1
-        checkpoint.to_directory(path)
+        """Persist + score a reported checkpoint; returns the dir-backed one.
+        A checkpoint already living under the storage path (e.g. a sharded
+        save written host-parallel by workers) is registered IN PLACE —
+        copying a pod-scale sharded state would defeat the point."""
+        if checkpoint.path is not None and os.path.abspath(
+            checkpoint.path
+        ).startswith(os.path.abspath(self.storage_path) + os.sep):
+            from ray_tpu.train import sharded_checkpoint as _sc
+
+            if os.path.exists(
+                os.path.join(checkpoint.path, _sc.MANIFEST_FILE)
+            ) or os.path.exists(
+                os.path.join(checkpoint.path, "COMMIT")
+            ):
+                if not _sc.is_committed(checkpoint.path):
+                    raise ValueError(
+                        f"sharded checkpoint {checkpoint.path} is not "
+                        f"committed yet — handle.wait() before registering"
+                    )
+            path = checkpoint.path
+            self._index += 1
+        else:
+            path = os.path.join(self.storage_path,
+                                f"checkpoint_{self._index:06d}")
+            self._index += 1
+            checkpoint.to_directory(path)
         clean = {k: v for k, v in metrics.items()
                  if isinstance(v, (int, float, str, bool))}
         with open(os.path.join(path, "meta.json"), "w") as f:
