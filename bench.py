@@ -69,6 +69,34 @@ def run_data_ingest_bench():
     }
 
 
+def run_rl_bench():
+    """RL throughput datapoint (VERDICT r3 item 6): IMPALA on the in-repo
+    MinAtar Atari proxy — async env-runner actors + the dp-sharded
+    LearnerGroup update; reports env-steps/s."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(
+        env="MinAtar-Breakout", num_workers=2, num_learners=1,
+        rollout_len=256,
+    ).build()
+    try:
+        algo.train()  # compile + pipeline warmup
+        base = algo.num_env_steps
+        t0 = time.perf_counter()
+        for _ in range(3):
+            m = algo.train()
+        dt = time.perf_counter() - t0
+        return {
+            "impala_env_steps_per_s": round(
+                (algo.num_env_steps - base) / dt, 1
+            ),
+            "episode_reward_mean": round(m["episode_reward_mean"], 2),
+            "num_workers": 2,
+        }
+    finally:
+        algo.stop()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -283,6 +311,10 @@ def main():
                 tasks_n=100, actor_calls_n=200, put_mb=16, put_n=5
             )
             micro["data_ingest"] = run_data_ingest_bench()
+            try:
+                micro["rl"] = run_rl_bench()
+            except Exception as e:  # keep the measured micro numbers
+                micro["rl"] = {"error": str(e)[:160]}
         finally:
             ray_tpu.shutdown()
     except Exception as e:  # the MFU headline must survive a micro failure

@@ -12,6 +12,8 @@ actor-critic update (ppo.py).
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig"]
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+           "LearnerGroup"]

@@ -10,10 +10,11 @@ import ray_tpu
 
 
 def probe_env_spec(env_name: str) -> Tuple[int, int]:
-    """(obs_dim, num_actions) for a discrete-action gymnasium env."""
-    import gymnasium
+    """(obs_dim, num_actions) for a discrete-action env (in-repo MinAtar
+    names or gymnasium)."""
+    from ray_tpu.rllib.envs import make_env
 
-    probe = gymnasium.make(env_name)
+    probe = make_env(env_name)
     try:
         if not hasattr(probe.action_space, "n"):
             raise ValueError(

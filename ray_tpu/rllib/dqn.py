@@ -88,14 +88,14 @@ class _TransitionWorker:
         import os
 
         os.environ["JAX_PLATFORMS"] = "cpu"
-        import gymnasium
+        from ray_tpu.rllib.envs import make_env
         import jax
 
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
-        self.env = gymnasium.make(env_name)
+        self.env = make_env(env_name)
         self.rollout_len = rollout_len
         self.rng = np.random.default_rng(seed)
         self.obs, _ = self.env.reset(seed=seed)

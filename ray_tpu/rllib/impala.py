@@ -62,6 +62,44 @@ def vtrace(
     return vs, pg_adv
 
 
+def make_impala_loss(config: "IMPALAConfig"):
+    """Batched IMPALA loss over [B, T] rollouts: V-trace vmapped over the
+    trajectory axis, means over B*T — the leading axis is shardable, so
+    the SAME loss runs dp=1 or dp-sharded across a LearnerGroup."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+
+    def loss_fn(params, batch):
+        B, T = batch["actions"].shape
+        obs = batch["obs"].reshape(B * T, -1)
+        logits, values = apply_actor_critic(params, obs)
+        logits = logits.reshape(B, T, -1)
+        values = values.reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        vs, pg_adv = jax.lax.stop_gradient(
+            jax.vmap(
+                lambda blp, tlp, r, v, nv, t, cu: vtrace(
+                    blp, tlp, r, v, nv, t, cu,
+                    c.gamma, c.rho_bar, c.c_bar,
+                )
+            )(
+                batch["logp"], target_logp, batch["rewards"], values,
+                batch["next_values"], batch["terminals"], batch["cuts"],
+            )
+        )
+        pg = -(target_logp * pg_adv).mean()
+        vf = ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pg + c.vf_coef * vf - c.entropy_coef * entropy
+
+    return loss_fn
+
+
 @dataclasses.dataclass
 class IMPALAConfig:
     env: str = "CartPole-v1"
@@ -75,110 +113,108 @@ class IMPALAConfig:
     c_bar: float = 1.0
     hidden: tuple = (64, 64)
     seed: int = 0
+    # learners in the dp-sharded LearnerGroup; each update consumes
+    # num_learners completed rollouts (reference: IMPALA multi-learner,
+    # learner_group.py:61)
+    num_learners: int = 1
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
 
 
 class IMPALA:
-    """``algo.train()`` = consume a few asynchronously completed rollouts,
-    one V-trace SGD step per rollout, per-worker weight refresh."""
+    """``algo.train()`` = consume asynchronously completed rollouts in
+    groups of ``num_learners``, one dp-sharded V-trace SGD step per group,
+    weight refresh for the consumed workers."""
 
     def __init__(self, config: IMPALAConfig):
         import jax
         import optax
 
         from ray_tpu.rllib.common import make_rollout_workers, probe_env_spec
+        from ray_tpu.rllib.learner_group import LearnerGroup
 
         self.config = config
+        if config.num_workers < config.num_learners:
+            raise ValueError(
+                "need num_workers >= num_learners (one rollout per learner "
+                "shard per update)"
+            )
         obs_dim, num_actions = probe_env_spec(config.env)
-        self.params = init_actor_critic(
+        params = init_actor_critic(
             jax.random.key(config.seed), obs_dim, num_actions, config.hidden
         )
-        self.opt = optax.adam(config.lr)
-        self.opt_state = self.opt.init(self.params)
-        self._update = jax.jit(self._make_update())
+        self.learners = LearnerGroup(
+            make_impala_loss(config), params, optax.adam(config.lr),
+            num_learners=config.num_learners,
+        )
         self.workers = make_rollout_workers(
             config.env, config.num_workers, config.rollout_len,
             config.gamma, 1.0, config.seed,
         )
         # async pipeline state: one in-flight rollout per worker
         self._inflight: Dict[Any, int] = {}
-        params_ref = ray_tpu.put(jax.device_get(self.params))
+        params_ref = ray_tpu.put(self.learners.get_params_host())
         for i, w in enumerate(self.workers):
             self._inflight[w.sample.remote(params_ref)] = i
         self._iter = 0
         self.num_async_updates = 0
+        self.num_env_steps = 0
         self._recent: List[float] = []
+        self.last_loss = float("nan")
 
-    def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-        import optax
-
-        c = self.config
-
-        def loss_fn(params, batch):
-            logits, values = apply_actor_critic(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            target_logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1
-            )[:, 0]
-            vs, pg_adv = jax.lax.stop_gradient(
-                vtrace(
-                    batch["logp"], target_logp, batch["rewards"],
-                    values, batch["next_values"],
-                    batch["terminals"], batch["cuts"],
-                    c.gamma, c.rho_bar, c.c_bar,
-                )
-            )
-            pg = -(target_logp * pg_adv).mean()
-            vf = ((values - vs) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            return pg + c.vf_coef * vf - c.entropy_coef * entropy
-
-        def update(params, opt_state, batch):
-            grads = jax.grad(loss_fn)(params, batch)
-            updates, opt_state = self.opt.update(grads, opt_state)
-            return optax.apply_updates(params, updates), opt_state
-
-        return update
+    def _stack(self, rollouts: List[Dict]) -> Dict[str, np.ndarray]:
+        keys = ("obs", "actions", "logp", "rewards", "next_values",
+                "terminals", "cuts")
+        return {k: np.stack([r[k] for r in rollouts]) for k in keys}
 
     def train(self) -> Dict[str, Any]:
-        """One iteration: process num_workers asynchronously completed
-        rollouts (whichever finish first — no barrier)."""
-        import jax
-
+        """One iteration: ``(num_workers // num_learners) * num_learners``
+        rollouts consumed, in groups of num_learners (whichever finish
+        first — no global barrier; with non-divisible configs the
+        remainder worker keeps sampling and is consumed next round)."""
+        c = self.config
         self._iter += 1
-        for _ in range(self.config.num_workers):
-            ready, _ = ray_tpu.wait(
-                list(self._inflight), num_returns=1, timeout=300
-            )
-            if not ready:
-                raise TimeoutError("no rollout completed within 300s")
-            ref = ready[0]
-            widx = self._inflight.pop(ref)
-            rollout = ray_tpu.get(ref)
-            self._recent.extend(rollout["episode_returns"].tolist())
+        groups = max(1, c.num_workers // c.num_learners)
+        for _ in range(groups):
+            got, widxs = [], []
+            try:
+                while len(got) < c.num_learners:
+                    ready, _ = ray_tpu.wait(
+                        list(self._inflight),
+                        num_returns=min(
+                            c.num_learners - len(got), len(self._inflight)
+                        ),
+                        timeout=300,
+                    )
+                    if not ready:
+                        raise TimeoutError(
+                            "no rollout completed within 300s"
+                        )
+                    for ref in ready:
+                        widxs.append(self._inflight.pop(ref))
+                        got.append(ray_tpu.get(ref))
+            except BaseException:
+                # leave the pipeline retryable: resubmit any workers whose
+                # rollouts were popped before the failure
+                params_ref = ray_tpu.put(self.learners.get_params_host())
+                for widx in widxs:
+                    self._inflight[
+                        self.workers[widx].sample.remote(params_ref)
+                    ] = widx
+                raise
+            for rollout in got:
+                self._recent.extend(rollout["episode_returns"].tolist())
+                self.num_env_steps += len(rollout["actions"])
             self._recent = self._recent[-100:]
-            batch = {
-                "obs": rollout["obs"],
-                "actions": rollout["actions"],
-                "logp": rollout["logp"],
-                "rewards": rollout["rewards"],
-                "next_values": rollout["next_values"],
-                "terminals": rollout["terminals"],
-                "cuts": rollout["cuts"],
-            }
-            self.params, self.opt_state = self._update(
-                self.params, self.opt_state, batch
-            )
+            self.last_loss = self.learners.update(self._stack(got))
             self.num_async_updates += 1
-            # refresh ONLY this worker and put it back to work (async)
-            params_ref = ray_tpu.put(jax.device_get(self.params))
-            self._inflight[
-                self.workers[widx].sample.remote(params_ref)
-            ] = widx
+            # refresh ONLY the consumed workers, resubmit them (async)
+            params_ref = ray_tpu.put(self.learners.get_params_host())
+            for widx in widxs:
+                self._inflight[
+                    self.workers[widx].sample.remote(params_ref)
+                ] = widx
         return {
             "training_iteration": self._iter,
             "episode_reward_mean": (
@@ -186,6 +222,9 @@ class IMPALA:
                 else float("nan")
             ),
             "num_async_updates": self.num_async_updates,
+            "num_env_steps": self.num_env_steps,
+            "loss": self.last_loss,
+            "num_learners": c.num_learners,
         }
 
     def stop(self):

@@ -27,14 +27,14 @@ class RolloutWorker:
         # Must happen before the backend initializes — querying
         # jax.default_backend() first would itself commit the TPU backend.
         os.environ["JAX_PLATFORMS"] = "cpu"
-        import gymnasium
+        from ray_tpu.rllib.envs import make_env
         import jax
 
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass  # backend already initialized (fresh workers never are)
-        self.env = gymnasium.make(env_name)
+        self.env = make_env(env_name)
         self.rollout_len = rollout_len
         self.gamma = gamma
         self.lam = lam

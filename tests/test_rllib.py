@@ -206,3 +206,98 @@ def test_dqn_cartpole_learns(rt_rl):
         assert best >= 150, f"DQN plateaued at {best}"
     finally:
         algo.stop()
+
+
+# ---------------- round 3: multi-learner + MinAtar proxy ----------------
+
+
+def test_minatar_breakout_env():
+    """In-repo Atari proxy: deterministic physics, reward on brick hits,
+    termination on a missed ball."""
+    from ray_tpu.rllib.envs import MinAtarBreakout, make_env
+
+    env = make_env("MinAtar-Breakout")
+    assert isinstance(env, MinAtarBreakout)
+    obs, _ = env.reset(seed=3)
+    assert obs.shape == (300,) and obs.dtype == np.float32
+    assert obs.sum() >= 2  # paddle + ball + bricks present
+    total_r, steps, terminated = 0.0, 0, False
+    while steps < 500 and not terminated:
+        # trivial tracking policy: move paddle toward the ball
+        planes = obs.reshape(3, 10, 10)
+        ball_x = int(planes[1].sum(axis=0).argmax())
+        # paddle CENTER (the plane shows the 3-cell-wide paddle)
+        paddle_x = int(round(float(np.mean(np.nonzero(planes[0][9])[0]))))
+        a = 2 if ball_x > paddle_x else (0 if ball_x < paddle_x else 1)
+        obs, r, terminated, truncated, _ = env.step(a)
+        total_r += r
+        steps += 1
+        if truncated:
+            break
+    assert total_r > 0, "tracking policy never hit a brick"
+
+    # a stationary paddle loses the ball -> termination
+    env2 = make_env("MinAtar-Breakout")
+    env2.reset(seed=5)
+    done = False
+    for _ in range(200):
+        _, _, done, trunc, _ = env2.step(1)
+        if done or trunc:
+            break
+    assert done, "ball never missed a frozen paddle"
+
+
+def test_learner_group_dp2_matches_dp1(rt):
+    """VERDICT r3 criterion: the dp=2 learner update produces the same
+    loss/params as dp=1 on the same batch (XLA gradient all-reduce ==
+    single-device gradient)."""
+    import jax
+    import optax
+
+    from ray_tpu.rllib.impala import IMPALAConfig, make_impala_loss
+    from ray_tpu.rllib.learner_group import LearnerGroup
+    from ray_tpu.rllib.models import init_actor_critic
+
+    cfg = IMPALAConfig(rollout_len=32)
+    loss_fn = make_impala_loss(cfg)
+    params = init_actor_critic(jax.random.key(0), 4, 2, (32, 32))
+    rng = np.random.default_rng(0)
+    T = 32
+    batch = {
+        "obs": rng.random((2, T, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, (2, T)).astype(np.int32),
+        "logp": (-0.7 * np.ones((2, T))).astype(np.float32),
+        "rewards": rng.random((2, T)).astype(np.float32),
+        "next_values": rng.random((2, T)).astype(np.float32),
+        "terminals": np.zeros((2, T), np.float32),
+        "cuts": np.zeros((2, T), np.float32),
+    }
+    g1 = LearnerGroup(loss_fn, params, optax.adam(1e-3), num_learners=1)
+    g2 = LearnerGroup(loss_fn, params, optax.adam(1e-3), num_learners=2)
+    l1 = g1.update(batch)
+    l2 = g2.update(batch)
+    assert abs(l1 - l2) < 1e-4 * max(1.0, abs(l1)), (l1, l2)
+    p1, p2 = g1.get_params_host(), g2.get_params_host()
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_impala_multi_learner_minatar(rt):
+    """IMPALA with num_learners=2 on the MinAtar proxy: updates run
+    dp-sharded, env-steps accumulate, and the pipeline stays async."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(
+        env="MinAtar-Breakout", num_workers=2, num_learners=2,
+        rollout_len=128, seed=1,
+    ).build()
+    try:
+        for _ in range(3):
+            m = algo.train()
+        assert m["num_learners"] == 2
+        assert m["num_async_updates"] >= 3
+        assert m["num_env_steps"] >= 3 * 2 * 128
+        assert np.isfinite(m["loss"])
+    finally:
+        algo.stop()
