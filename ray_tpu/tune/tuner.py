@@ -10,6 +10,7 @@ feeds them to the scheduler (FIFO/ASHA/PBT), and assembles a ResultGrid.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -64,6 +65,19 @@ class Trial:
         self.checkpoint: Optional[Dict] = None  # latest reported (dict form)
         self.start_checkpoint: Optional[Dict] = None  # for PBT exploits
 
+    def __getstate__(self):
+        # Trials travel into the experiment-state snapshot (Tuner.restore);
+        # live handles don't survive a driver death and must not be
+        # serialized — schedulers keyed by Trial identity keep working
+        # because the UNPICKLED objects are the resumed trials themselves.
+        # The live actor's ID is recorded so restore can reap the orphan
+        # (a dead driver's trial actors otherwise hold resources forever).
+        d = dict(self.__dict__)
+        d["_stale_actor_id"] = getattr(self.actor, "_actor_id", None)
+        d["actor"] = None
+        d["poll_ref"] = None
+        return d
+
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status}, it={self.iterations})"
 
@@ -117,11 +131,87 @@ class Tuner:
         param_space: Optional[Dict[str, Any]] = None,
         tune_config: Optional[TuneConfig] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
+        storage_path: Optional[str] = None,
+        name: str = "tune_experiment",
     ):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.resources = resources_per_trial or {"CPU": 1}
+        self._exp_dir = (
+            os.path.join(storage_path, name) if storage_path else None
+        )
+        self._restored_state: Optional[Dict] = None
+
+    # -- experiment-level durability (parity: reference Tuner.restore,
+    # tune/impl/tuner_internal.py:56 + experiment checkpointing) --
+
+    STATE_FILE = "tuner_state.pkl"  # mutable sweep state, per-sweep write
+    META_FILE = "tuner_meta.pkl"    # static definition, written once
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None
+                ) -> "Tuner":
+        """Rebuild a Tuner from a crashed/killed experiment directory;
+        ``.fit()`` resumes unfinished trials from their last checkpoints
+        with the searcher/scheduler state (PBT population, ASHA rungs,
+        TPE observations) intact. Orphaned trial actors from the dead
+        driver are reaped on resume."""
+        import cloudpickle
+
+        path = path.rstrip(os.sep)
+        with open(os.path.join(path, cls.META_FILE), "rb") as f:
+            meta = cloudpickle.load(f)
+        with open(os.path.join(path, cls.STATE_FILE), "rb") as f:
+            st = cloudpickle.load(f)
+        t = cls(
+            trainable if trainable is not None else meta["trainable"],
+            param_space=meta["param_space"],
+            tune_config=meta["tune_config"],
+            resources_per_trial=meta["resources"],
+        )
+        t._exp_dir = path  # snapshots continue in place
+        t._restored_state = st
+        return t
+
+    def _atomic_dump(self, obj, fname: str):
+        import cloudpickle
+
+        tmp = os.path.join(self._exp_dir, fname + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(obj, f)
+        os.replace(tmp, os.path.join(self._exp_dir, fname))
+
+    def _persist_meta(self):
+        """The static experiment definition: written once per fit() (the
+        trainable closure can be arbitrarily large — keeping it out of
+        the per-sweep snapshot keeps the controller hot path cheap)."""
+        if self._exp_dir is None:
+            return
+        os.makedirs(self._exp_dir, exist_ok=True)
+        self._atomic_dump(
+            {
+                "trainable": self.trainable,
+                "param_space": self.param_space,
+                "tune_config": self.tune_config,
+                "resources": self.resources,
+            },
+            self.META_FILE,
+        )
+
+    def _persist(self, trials, spawned, searcher, scheduler):
+        if self._exp_dir is None:
+            return
+        self._atomic_dump(
+            {
+                "trials": trials,
+                "spawned": spawned,
+                "searcher": searcher,
+                "scheduler": scheduler,
+                "next_id": Trial._next,
+            },
+            self.STATE_FILE,
+        )
 
     # -- controller --
 
@@ -138,6 +228,30 @@ class Tuner:
             max_trials = None  # the generator itself exhausts
         trials: List[Trial] = []
         spawned = 0
+        resume: List[Trial] = []
+        if self._restored_state is not None:
+            st, self._restored_state = self._restored_state, None
+            trials = st["trials"]
+            spawned = st["spawned"]
+            searcher = st["searcher"]
+            scheduler = st["scheduler"]
+            Trial._next = max(Trial._next, st["next_id"])
+            for t in trials:
+                # reap the crashed driver's orphaned trial actor: it still
+                # holds its resources and would starve the resumed sweep
+                stale = t.__dict__.pop("_stale_actor_id", None)
+                if stale is not None:
+                    from ray_tpu.actor import ActorHandle
+
+                    try:
+                        ray_tpu.kill(ActorHandle(stale))
+                    except Exception:
+                        pass  # already dead / unknown
+                if t.status in (PENDING, RUNNING):
+                    # resume from the trial's last reported checkpoint
+                    t.start_checkpoint = t.checkpoint or t.start_checkpoint
+                    t.status = PENDING
+                    resume.append(t)
         actor_cls = ray_tpu.remote(resources=dict(self.resources))(
             _TrainWorker
         )
@@ -168,6 +282,12 @@ class Tuner:
                 trial.actor = None
 
         live: List[Trial] = []
+        for t in resume:
+            start(t)
+            live.append(t)
+        self._persist_meta()
+        self._persist(trials, spawned, searcher, scheduler)
+        dirty = False
         exhausted = False
         # A searcher returning None while not is_finished() means "nothing
         # to suggest right now" — back off and re-poll, bounded by an idle
@@ -199,6 +319,7 @@ class Tuner:
                     spawned += 1
                     start(t)
                     live.append(t)
+                    dirty = True
                 if not live:
                     if exhausted or (
                         max_trials is not None and spawned >= max_trials
@@ -239,6 +360,7 @@ class Tuner:
                     except Exception as e:
                         trial.status = ERROR
                         trial.error = f"trial actor died: {e!r}"
+                        dirty = True
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
                         searcher.on_trial_complete(
@@ -254,6 +376,7 @@ class Tuner:
                         trial.last_result = m
                         if ev.get("checkpoint") is not None:
                             trial.checkpoint = ev["checkpoint"]
+                        dirty = True
                         decision = scheduler.on_trial_result(trial, m)
                         if decision != CONTINUE:
                             break
@@ -263,6 +386,7 @@ class Tuner:
                         trial.actor.ack_report.remote()
                     if decision == STOP:
                         trial.status = TERMINATED
+                        dirty = True
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
                         searcher.on_trial_complete(
@@ -279,8 +403,14 @@ class Tuner:
                             stop_actor(trial)
                             trial.config = scheduler.explore(donor.config)
                             trial.start_checkpoint = donor.checkpoint
+                            # the donor's checkpoint is now authoritative
+                            # for this trial: a crash-resume must restart
+                            # from the EXPLOITED weights, not the trial's
+                            # own pre-exploit checkpoint
+                            trial.checkpoint = donor.checkpoint
                             trial.iterations = donor.iterations
                             start(trial)
+                            dirty = True
                         still.append(trial)
                         continue
                     if p["done"]:
@@ -291,6 +421,7 @@ class Tuner:
                             )
                         else:
                             trial.status = TERMINATED
+                        dirty = True
                         stop_actor(trial)
                         scheduler.on_trial_complete(trial, trial.last_result)
                         searcher.on_trial_complete(
@@ -301,9 +432,15 @@ class Tuner:
                         continue
                     still.append(trial)
                 live = still
+                if dirty:
+                    # durable sweep: a killed driver resumes from here
+                    # (reference tuner_internal.py:56 restore path)
+                    self._persist(trials, spawned, searcher, scheduler)
+                    dirty = False
         finally:
             for t in trials:
                 stop_actor(t)
+            self._persist(trials, spawned, searcher, scheduler)
         results = [
             TrialResult(
                 config=t.config,
