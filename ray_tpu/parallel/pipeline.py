@@ -1,21 +1,29 @@
-"""Pipeline parallelism: a GPipe schedule over the ``pp`` mesh axis.
+"""Pipeline parallelism over the ``pp`` mesh axis: GPipe and 1F1B.
 
 The transformer's layer stack is partitioned into ``pp`` contiguous stages
 (the stacked layer params are sharded on their leading L dim by the
 ``layers -> pp`` rule, so each device holds L/pp layers). Inside
-``shard_map`` every stage runs the same SPMD program: at schedule tick t,
-stage p applies its layers to microbatch (t - p), then the activation block
-rotates to stage p+1 via ``lax.ppermute`` (one ICI neighbour hop). After
-M + pp - 1 ticks every microbatch has crossed every stage; the last stage
-accumulates the LM loss, which is ``psum``-reduced to every device. The
-whole schedule is a ``lax.scan`` — one compiled XLA program, static control
-flow, differentiable end to end (the backward pipeline is the transposed
-scan with reversed ppermutes, derived by AD — no hand-written 1F1B).
+``shard_map`` every stage runs the same SPMD program; activation blocks
+rotate between neighbour stages via ``lax.ppermute`` (one ICI hop).
 
-Composes with data parallelism (batch over ``dp``); tensor/sequence/expert
-axes must be 1 inside the pipelined region for now (those compose via GSPMD
-in the non-pipelined path). Reference ships NO pipeline parallelism
-(SURVEY.md §2.5 — Alpa release tests only); this is the native TPU design.
+Two schedules:
+
+- **GPipe** (``pipeline_loss_fn``): all-forward then all-backward, the
+  backward derived by AD through the schedule scan. Simple, but live
+  activation state grows with the microbatch count M.
+- **1F1B** (``pipeline_grads_1f1b``): a hand-written interleaved schedule —
+  each tick runs one forward AND one backward microbatch per stage, with
+  the backward realized by ``jax.vjp`` over a RECOMPUTED stage forward
+  from a ring buffer of stage inputs. In-flight state per stage is
+  bounded by the ring (~2·pp slots) instead of M, so activation memory is
+  O(pp), not O(M) — the memory-aware schedule for long microbatch trains.
+
+Tensor parallelism COMPOSES with both: the shard_map is manual only over
+``(dp, pp)`` (``axis_names=``), leaving ``tp`` to GSPMD inside each stage
+program — stage matmuls are tp-sharded exactly as in the non-pipelined
+path. sp/ep must still be 1 inside the pipelined region. Reference ships
+NO pipeline parallelism (SURVEY.md §2.5 — Alpa release tests only); this
+is the native TPU design.
 """
 
 from __future__ import annotations
@@ -50,6 +58,32 @@ def _param_specs(config: TransformerConfig, rules: AxisRules):
     )
 
 
+_MANUAL_AXES = frozenset({"dp", "pp"})
+
+
+def _restrict_spec(spec: P) -> P:
+    """Keep only the MANUAL (dp/pp) axes of a PartitionSpec: the pipeline's
+    shard_map is manual over (dp, pp) only, with tp left to GSPMD inside
+    the stage program (``axis_names``) — tp partitioning rides the arrays'
+    own shardings, not the shard_map specs."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in _MANUAL_AXES)
+            return kept if kept else None
+        return entry if entry in _MANUAL_AXES else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def _pipeline_specs(config: TransformerConfig, rules: AxisRules):
+    pspecs = jax.tree.map(_restrict_spec, _param_specs(config, rules),
+                          is_leaf=lambda x: isinstance(x, P))
+    data_spec = _restrict_spec(logical_to_spec(rules, ("batch", None)))
+    return pspecs, data_spec
+
+
 def pipeline_loss_fn(
     params: Dict,
     batch: Dict[str, jax.Array],
@@ -62,11 +96,11 @@ def pipeline_loss_fn(
     layer stack as a pp-stage pipeline. Call inside jit."""
     c = config
     pp = mesh.shape["pp"]
-    for ax in ("tp", "sp", "ep"):
+    for ax in ("sp", "ep"):
         if mesh.shape[ax] != 1:
             raise ValueError(
                 f"pipeline_loss_fn requires {ax}=1 (got {mesh.shape[ax]}); "
-                "tp/sp/ep compose via the GSPMD (non-pipelined) path"
+                "sp/ep compose via the GSPMD (non-pipelined) path"
             )
     if c.n_layers % pp:
         raise ValueError(
@@ -161,8 +195,7 @@ def pipeline_loss_fn(
             ce = ce + c.moe_aux_weight * aux / den
         return ce
 
-    pspecs = _param_specs(c, rules)
-    data_spec = logical_to_spec(rules, ("batch", None))
+    pspecs, data_spec = _pipeline_specs(c, rules)
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(batch["tokens"].shape, jnp.float32)
@@ -171,6 +204,188 @@ def pipeline_loss_fn(
         mesh=mesh,
         in_specs=(pspecs, data_spec, data_spec, data_spec),
         out_specs=P(),
+        axis_names=_MANUAL_AXES,  # tp stays GSPMD-auto inside stages
+        check_vma=False,
+    )(params, batch["tokens"], batch["targets"], mask)
+
+
+def pipeline_grads_1f1b(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    rules: AxisRules = DEFAULT_RULES,
+) -> Tuple[jax.Array, Dict]:
+    """Interleaved (1F1B-style) pipeline: returns ``(loss, grads)`` with a
+    HAND-WRITTEN backward — each schedule tick runs one forward microbatch
+    and one backward microbatch per stage. The backward recomputes the
+    stage forward from a ring buffer of stage INPUTS (``jax.vjp`` at the
+    backward tick), so live activation state is the ring (~2·pp blocks of
+    [mb, S, d]) regardless of the microbatch count — the GPipe-through-AD
+    path's activation state grows with M instead.
+
+    Schedule (uniform SPMD, stage p at tick t):
+      forward microbatch  f = t - p
+      backward microbatch b = t - (2·(pp-1) - p)
+    so the last stage backs up a microbatch immediately after forwarding
+    it, and gradients ripple to stage 0 over pp-1 reverse hops.
+    """
+    c = config
+    pp = mesh.shape["pp"]
+    for ax in ("sp", "ep"):
+        if mesh.shape[ax] != 1:
+            raise ValueError(f"1F1B pipeline requires {ax}=1")
+    if c.n_layers % pp:
+        raise ValueError(f"pp={pp} must divide n_layers={c.n_layers}")
+    if c.attn_impl != "dense":
+        raise ValueError("pipeline stages use dense attention (sp=1)")
+    if c.moe_experts:
+        raise ValueError("1F1B pipeline does not support MoE aux losses")
+    M = num_microbatches
+    W = 2 * pp  # ring slots: max input lifetime is 2*(pp-1) ticks
+
+    def body(params, tokens, targets, mask):
+        p = lax.axis_index("pp")
+        b, S = tokens.shape  # dp-local batch
+        if b % M:
+            raise ValueError(
+                f"local batch {b} not divisible by {M} microbatches"
+            )
+        mb = b // M
+        d = c.d_model
+        positions = jnp.arange(S)
+        toks = tokens.reshape(M, mb, S)
+        tgts = targets.reshape(M, mb, S)
+        msks = mask.reshape(M, mb, S)
+        is_last = (p == pp - 1)
+
+        def stage_fn(prm, x_act, idx, score: bool):
+            """One stage's forward for microbatch ``idx``: ingestion on
+            stage 0, the local layer shard, and (when ``score``) the
+            masked last-stage loss — all inside one function so vjp yields
+            embed/head grads on exactly the stages that own those terms.
+
+            Scoring runs ONLY inside the backward-tick vjp (each
+            microbatch is scored exactly once there); the forward tick
+            skips the vocab projection. Non-last stages still execute the
+            masked projection during backward ticks — per-device ``p``
+            rules out lax.cond (collective mismatch under tp-auto), the
+            known cost of uniform-SPMD stages."""
+            tok = lax.dynamic_index_in_dim(toks, idx, 0, keepdims=False)
+            embed = prm["embed"].astype(c.dtype)
+            x_in = jnp.where(p == 0, embed[tok], x_act)
+
+            def lyr(carry, lp):
+                y, a, _ = apply_layer(
+                    carry, lp, c, positions, causal_attention, mesh=None
+                )
+                return y, a
+
+            lyr = remat_wrap(lyr, c)
+            x_out, _aux = lax.scan(lyr, x_in, prm["layers"])
+            if not score:
+                return x_out
+            xl = _rms_norm(x_out, prm["final_ln"]["scale"])
+            head = (
+                prm["embed"].T if c.tie_embeddings else prm["lm_head"]
+            ).astype(c.dtype)
+            logits = jnp.einsum("msd,dv->msv", xl, head).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tgt = lax.dynamic_index_in_dim(tgts, idx, 0, keepdims=False)
+            mk = lax.dynamic_index_in_dim(msks, idx, 0, keepdims=False)
+            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            gate = jnp.where(is_last, 1.0, 0.0)
+            loss_sum = -(ll * mk).sum() * gate
+            cnt = mk.sum() * gate
+            return x_out, loss_sum, cnt
+
+        T = M + 2 * pp - 2
+
+        def tick(carry, t):
+            act_in, g_in, ring, grads, loss_sum, count = carry
+            # ---- forward slot (no scoring: see stage_fn docstring) ----
+            f = t - p
+            f_act = (f >= 0) & (f < M)
+            fidx = jnp.clip(f, 0, M - 1)
+            x_out = stage_fn(params, act_in, fidx, score=False)
+            slot = fidx % W
+            cur = lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+            ring = lax.dynamic_update_index_in_dim(
+                ring, jnp.where(f_act, act_in, cur), slot, 0
+            )
+            # ---- backward slot (vjp over a recomputed, SCORED stage) ----
+            bmb = t - (2 * (pp - 1) - p)
+            b_act = (bmb >= 0) & (bmb < M)
+            bidx = jnp.clip(bmb, 0, M - 1)
+            rx = lax.dynamic_index_in_dim(
+                ring, bidx % W, 0, keepdims=False
+            )
+            # cotangents: upstream activation grad for non-last stages
+            # (zeroed when inactive), loss seed 1.0 on the last stage
+            g_eff = jnp.where(b_act & ~is_last, 1.0, 0.0) * g_in
+            loss_bar = jnp.where(b_act & is_last, 1.0, 0.0)
+            (_, lsum, cnt), vjp_fn = jax.vjp(
+                lambda pr, xa: stage_fn(pr, xa, bidx, score=True),
+                params, rx,
+            )
+            # each microbatch is scored exactly once: at its backward tick
+            loss_sum = loss_sum + jnp.where(b_act, lsum, 0.0)
+            count = count + jnp.where(b_act, cnt, 0.0)
+            gp, gx = vjp_fn((
+                g_eff.astype(c.dtype),
+                loss_bar.astype(jnp.float32),
+                jnp.zeros((), jnp.float32),
+            ))
+            grads = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                                 grads, gp)
+            # ---- rotate: activations forward, grads backward ----
+            act_next = lax.ppermute(
+                x_out, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            g_next = lax.ppermute(
+                gx.astype(c.dtype), "pp",
+                [(i, (i - 1) % pp) for i in range(pp)],
+            )
+            return (act_next, g_next, ring, grads, loss_sum, count), None
+
+        init = (
+            jnp.zeros((mb, S, d), c.dtype),
+            jnp.zeros((mb, S, d), c.dtype),
+            jnp.zeros((W, mb, S, d), c.dtype),
+            jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, grads, loss_sum, count), _ = lax.scan(
+            tick, init, jnp.arange(T)
+        )
+        total = lax.psum(loss_sum, ("dp", "pp"))
+        n = jnp.maximum(lax.psum(count, ("dp", "pp")), 1.0)
+        ce = total / n
+        # grad of mean = accumulated sum-grads / token count; layer shards
+        # are pp-local (each stage owns its slice), everything else is
+        # replicated across pp and needs the pp-reduction too
+        def finalize(path, g):
+            g = g / n
+            g = lax.psum(g, "dp")
+            if not (path and getattr(path[0], "key", None) == "layers"):
+                g = lax.psum(g, "pp")
+            return g
+
+        grads = jax.tree_util.tree_map_with_path(finalize, grads)
+        return ce, grads
+
+    pspecs, data_spec = _pipeline_specs(c, rules)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(batch["tokens"].shape, jnp.float32)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec, data_spec),
+        out_specs=(P(), pspecs),
+        axis_names=_MANUAL_AXES,
         check_vma=False,
     )(params, batch["tokens"], batch["targets"], mask)
 
@@ -182,17 +397,33 @@ def make_pipeline_train_step(
     state_shardings: Any,
     num_microbatches: int,
     rules: AxisRules = DEFAULT_RULES,
+    schedule: str = "gpipe",
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
-    """Pipelined twin of ``train_step.make_train_step``: same step contract,
-    with the pipeline schedule plugged in as the loss."""
+    """Pipelined twin of ``train_step.make_train_step``: same step contract.
+    ``schedule="gpipe"`` differentiates the forward schedule by AD;
+    ``schedule="1f1b"`` uses the interleaved hand-written backward
+    (bounded activation memory — see pipeline_grads_1f1b)."""
     from ray_tpu.parallel.train_step import make_train_step
 
+    if schedule == "gpipe":
+        return make_train_step(
+            config,
+            mesh,
+            optimizer,
+            state_shardings,
+            rules=rules,
+            loss=partial(pipeline_loss_fn,
+                         num_microbatches=num_microbatches, rules=rules),
+        )
+    if schedule != "1f1b":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     return make_train_step(
         config,
         mesh,
         optimizer,
         state_shardings,
         rules=rules,
-        loss=partial(pipeline_loss_fn, num_microbatches=num_microbatches,
-                     rules=rules),
+        grads_fn=lambda params, batch: pipeline_grads_1f1b(
+            params, batch, config, mesh, num_microbatches, rules
+        ),
     )

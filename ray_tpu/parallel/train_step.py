@@ -103,14 +103,22 @@ def make_train_step(
     state_shardings: Any,
     rules: AxisRules = DEFAULT_RULES,
     loss: Callable = loss_fn,
+    grads_fn: Optional[Callable] = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
-    """Jitted, donated train step: (state, batch) -> (state, metrics)."""
+    """Jitted, donated train step: (state, batch) -> (state, metrics).
+
+    ``grads_fn(params, batch) -> (loss, grads)`` overrides the default
+    AD-of-``loss`` (used by schedules with a hand-written backward, e.g.
+    the 1F1B pipeline)."""
     data_sh = batch_sharding(mesh, rules)
 
     def step_fn(state: TrainState, batch):
-        loss_val, grads = jax.value_and_grad(loss)(
-            state.params, batch, config, mesh
-        )
+        if grads_fn is not None:
+            loss_val, grads = grads_fn(state.params, batch)
+        else:
+            loss_val, grads = jax.value_and_grad(loss)(
+                state.params, batch, config, mesh
+            )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

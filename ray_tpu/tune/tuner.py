@@ -319,7 +319,11 @@ class Tuner:
                     spawned += 1
                     start(t)
                     live.append(t)
-                    dirty = True
+                    # persist IMMEDIATELY: a driver death between spawn and
+                    # the end-of-round snapshot would otherwise leak an
+                    # actor that restore() can never reap
+                    self._persist(trials, spawned, searcher, scheduler)
+                    dirty = False
                 if not live:
                     if exhausted or (
                         max_trials is not None and spawned >= max_trials

@@ -247,3 +247,94 @@ def test_in_graph_collective_verbs():
         out_specs=(P(), P("dp", None)), check_vma=False,
     )(xs)
     assert float(total) == float(x.sum())
+
+
+def test_pipeline_composes_with_tp():
+    """pp x tp: the stage program is tp-sharded by GSPMD inside the manual
+    (dp, pp) shard_map — loss and grads still match dense exactly."""
+    cfg = _f32_tiny(max_seq_len=32, n_layers=4)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": tokens,
+             "mask": jnp.ones((8, 32), jnp.float32)}
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, tp=2))
+
+    ref = float(loss_fn(params, batch, cfg))
+    pl = float(
+        jax.jit(
+            lambda p, b: pipeline_loss_fn(p, b, cfg, mesh, num_microbatches=2)
+        )(params, batch)
+    )
+    assert abs(ref - pl) < 1e-5, (ref, pl)
+    gd = jax.grad(lambda p: loss_fn(p, batch, cfg))(params)
+    gp = jax.jit(
+        jax.grad(
+            lambda p: pipeline_loss_fn(p, batch, cfg, mesh, num_microbatches=2)
+        )
+    )(params)
+    errs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), gd, gp)
+    assert max(jax.tree.leaves(errs)) < 1e-5, errs
+
+
+def test_1f1b_grads_match_dense():
+    """The hand-written interleaved backward reproduces dense grads."""
+    from ray_tpu.parallel.pipeline import pipeline_grads_1f1b
+
+    cfg = _f32_tiny(max_seq_len=32, n_layers=4)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": tokens,
+             "mask": jnp.ones((8, 32), jnp.float32)}
+    for mesh_cfg, M in ((MeshConfig(dp=2, pp=4), 2),
+                        (MeshConfig(dp=2, pp=4), 4),
+                        (MeshConfig(dp=2, pp=2, tp=2), 2)):
+        mesh = build_mesh(mesh_cfg)
+        ref_l = float(loss_fn(params, batch, cfg))
+        gd = jax.grad(lambda p: loss_fn(p, batch, cfg))(params)
+        l, g = jax.jit(
+            lambda p, b: pipeline_grads_1f1b(p, b, cfg, mesh,
+                                             num_microbatches=M)
+        )(params, batch)
+        assert abs(ref_l - float(l)) < 1e-5, (mesh_cfg, ref_l, float(l))
+        errs = jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()), gd, g
+        )
+        assert max(jax.tree.leaves(errs)) < 1e-4, (mesh_cfg, errs)
+
+
+def test_1f1b_train_step_and_memory_vs_gpipe():
+    """1F1B trains (loss decreases) and its compiled activation footprint
+    beats GPipe's at many microbatches (the schedule exists to bound
+    in-flight activations by ~pp instead of M)."""
+    from ray_tpu.parallel.pipeline import make_pipeline_train_step
+
+    cfg = _f32_tiny(max_seq_len=64, n_layers=4, d_model=128, d_ff=512)
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    opt = default_optimizer(lr=1e-2)
+    state, state_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
+    M = 8
+    tokens = jnp.ones((16, 64), jnp.int32)
+    batch = {
+        "tokens": jax.device_put(tokens, batch_sharding(mesh)),
+        "targets": jax.device_put(tokens, batch_sharding(mesh)),
+        "mask": jax.device_put(jnp.ones((16, 64), jnp.float32),
+                               batch_sharding(mesh)),
+    }
+    step_1f1b = make_pipeline_train_step(
+        cfg, mesh, opt, state_sh, num_microbatches=M, schedule="1f1b"
+    )
+    step_gpipe = make_pipeline_train_step(
+        cfg, mesh, opt, state_sh, num_microbatches=M, schedule="gpipe"
+    )
+    mem = {}
+    for name, step in (("1f1b", step_1f1b), ("gpipe", step_gpipe)):
+        lowered = step.lower(state, batch)
+        ana = lowered.compile().memory_analysis()
+        mem[name] = int(getattr(ana, "temp_size_in_bytes", 0))
+    assert mem["1f1b"] < mem["gpipe"], mem
+
+    losses = []
+    for _ in range(5):
+        state, m = step_1f1b(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
