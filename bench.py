@@ -320,6 +320,36 @@ def main():
     except Exception as e:  # the MFU headline must survive a micro failure
         micro = {"error": str(e)[:160]}
 
+    # ---- perf floor gate (reference ray_perf.py role: a GATE, not a
+    # printout — regressions fail the bench run) ----
+    FLOORS = {
+        # floors catch order-of-magnitude regressions (a broken fast path,
+        # an accidental sync loop) while tolerating a loaded bench machine;
+        # recent quiet-machine numbers: ~800-1300 tasks/s, ~1800 pipelined,
+        # ~2.5 GB/s put
+        "tasks_per_s": 150.0,
+        "actor_calls_pipelined_per_s": 300.0,
+        "put_gbps": 0.4,
+    }
+    violations = []
+    if isinstance(micro, dict) and "error" not in micro:
+        for key, floor in FLOORS.items():
+            val = micro.get(key)
+            if val is not None and val < floor:
+                violations.append(
+                    {"metric": key, "value": val, "floor": floor}
+                )
+        ingest = micro.get("data_ingest") or {}
+        if ingest.get("speedup", 1e9) < 10.0:
+            violations.append({
+                "metric": "data_ingest_speedup",
+                "value": ingest.get("speedup"), "floor": 10.0,
+            })
+    if on_accel and mfu < 0.40:
+        violations.append(
+            {"metric": metric, "value": mfu, "floor": 0.40}
+        )
+
     out = {
         "metric": metric,
         "value": round(mfu, 4),
@@ -335,9 +365,14 @@ def main():
             "inference": inference,
             "serving": serving,
             "micro": micro,
+            "floor_violations": violations,
         },
     }
     print(json.dumps(out))
+    if violations:
+        print(f"PERF FLOOR VIOLATIONS: {violations}", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -102,6 +102,10 @@ _d("object_transfer_max_concurrent_chunks", int, 4)
 # how many tasks an owner keeps in flight per lease (arg staging overlaps:
 # a slow-transfer task doesn't stall the lease pipeline)
 _d("lease_push_pipeline_depth", int, 2)
+# cap on concurrent lease requests per (resources, strategy) key: enough
+# to saturate a node's parallelism without parking one request per queued
+# task at the raylet (100k-deep queues)
+_d("max_lease_requests_in_flight", int, 32)
 _d("memory_monitor_refresh_ms", int, 250)
 _d("memory_usage_threshold", float, 0.95)
 _d("event_stats_enabled", bool, True)

@@ -311,6 +311,9 @@ class CoreWorker:
         )
         # function/actor-class tables
         self._exported: set = set()
+        import weakref
+
+        self._export_memo = weakref.WeakKeyDictionary()
         self._fn_cache: Dict[bytes, Any] = {}
 
         # ownership / reference counting
@@ -632,7 +635,29 @@ class CoreWorker:
     def _put_to_plasma(self, oid: ObjectID, value) -> None:
         """Blocking variant for compute threads (NOT the IO loop)."""
         self._write_to_store(oid, value)
-        self.gcs.call("add_object_location", [oid.binary(), self.node_id])
+        # Location registration rides the IO loop instead of blocking the
+        # put (one RPC round trip per put otherwise). A consumer racing
+        # ahead of the registration sees a failed pull and re-requests —
+        # the get path's time-based re-pull absorbs the window.
+        self.io.submit(self._register_location(oid))
+
+    async def _register_location(self, oid: ObjectID):
+        wire = [oid.binary(), self.node_id]
+        try:
+            await self.gcs.conn.call_async("add_object_location", wire,
+                                           timeout=30)
+        except Exception:
+            # conn blip: retry through the RECONNECTING sync client off the
+            # loop (silently dropping a registration would strand the
+            # object for every remote puller)
+            try:
+                await asyncio.to_thread(
+                    lambda: self.gcs.call("add_object_location", wire,
+                                          timeout=30)
+                )
+            except Exception as e:
+                logger.warning("location registration failed for %s: %s",
+                               oid.hex()[:12], e)
 
     def put(self, value, _owner_inline=False) -> ObjectRef:
         """ray.put: store in the local shared-memory store; owner = self."""
@@ -905,12 +930,25 @@ class CoreWorker:
 
     # ================= function table =================
     def _export(self, prefix: str, obj) -> bytes:
+        # Per-object memo: re-pickling the same function for every one of
+        # 100k submits would dominate submission cost. WeakKeyDictionary
+        # so the memo can't outlive (or pin) the function object.
+        try:
+            cached = self._export_memo.get(obj)
+        except TypeError:
+            cached = None  # unhashable/unweakrefable: pickle every time
+        if cached is not None:
+            return cached
         blob = cloudpickle.dumps(obj)
         fid = hashlib.sha256(blob).digest()[:16]
         key = f"{prefix}:{self.job_id.hex()}:{fid.hex()}"
         if key not in self._exported:
             self.gcs.call("kv_put", [key, blob, False])
             self._exported.add(key)
+        try:
+            self._export_memo[obj] = fid
+        except TypeError:
+            pass
         return fid
 
     def _fetch(self, prefix: str, fid: bytes, job_id: Optional[bytes] = None):
@@ -1135,8 +1173,10 @@ class CoreWorker:
         # tasks need their own leases: counting active leases as capacity
         # here would serialize the whole queue behind one slow task (e.g.
         # one mid-transfer arg staging) on a cluster with idle workers.
-        # Late grants that find the queue empty return immediately.
-        want = len(st.queue)
+        # Late grants that find the queue empty return immediately. The
+        # in-flight request count is CAPPED: a deep queue (100k tasks)
+        # must not park one lease request per task at the raylet.
+        want = min(len(st.queue), GLOBAL_CONFIG.max_lease_requests_in_flight)
         have = st.requests_in_flight
         for _ in range(min(want - have, 8)):
             st.requests_in_flight += 1

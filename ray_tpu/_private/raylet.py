@@ -822,18 +822,28 @@ class Raylet:
         if self._stopping:
             return
         remaining = []
+        # Workers are fungible per kind (TPU / clean): once one grantable
+        # entry fails for lack of an idle worker of a kind, every later
+        # entry of that kind fails too — skip them wholesale so the pump
+        # is O(grants), not O(queue), per call (a 100k-deep queue would
+        # otherwise make each task completion scan the whole queue).
+        no_worker_kinds: Set[bool] = set()
         for summary, fut, conn in self.lease_queue:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
+            tpu_needed = resources.get("TPU", 0) > 0
+            if tpu_needed in no_worker_kinds:
+                remaining.append((summary, fut, conn))
+                continue
             if not self._can_acquire(summary):
                 remaining.append((summary, fut, conn))
                 continue
-            tpu_needed = resources.get("TPU", 0) > 0
             w = self._pop_idle_worker(tpu_needed)
             if w is None:
                 remaining.append((summary, fut, conn))
                 self._maybe_spawn_worker(tpu_needed)
+                no_worker_kinds.add(tpu_needed)
                 continue
             alloc = self._try_acquire(summary)
             if alloc is None:  # e.g. bundle pool exhausted while queued

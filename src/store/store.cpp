@@ -30,6 +30,12 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#ifndef MADV_POPULATE_WRITE
+// Linux 5.14+; build headers may predate it. The kernel rejects unknown
+// advice with EINVAL, which both call sites treat as best-effort.
+#define MADV_POPULATE_WRITE 23
+#endif
+
 namespace {
 
 constexpr uint64_t kMagic = 0x5254535452544F52ULL;  // "RTSTRTOR"
@@ -308,6 +314,14 @@ int rt_store_init(const char* path, uint64_t size, uint64_t table_capacity) {
   close(fd);
   if (base == MAP_FAILED) return -errno;
 
+  // Pre-fault the whole arena ONCE at store creation: without this, the
+  // first put into each fresh region pays per-page allocation faults
+  // (~5x bandwidth loss on 16MB puts measured on tmpfs). BEST-EFFORT
+  // only: on a small /dev/shm (tiny container shm limits) POPULATE fails
+  // with ENOMEM and we keep the old lazy behavior — a manual touch loop
+  // here would SIGBUS past tmpfs capacity.
+  madvise(base, size, MADV_POPULATE_WRITE);
+
   Header* h = H(base);
   memset(h, 0, sizeof(Header));
   h->version = kVersion;
@@ -364,6 +378,13 @@ void* rt_store_attach(const char* path, uint64_t* size_out) {
   close(fd);
   if (base == MAP_FAILED) return nullptr;
   if (H(base)->magic != kMagic) { munmap(base, (size_t)st.st_size); return nullptr; }
+#ifdef MADV_POPULATE_WRITE
+  // Build this process's PTEs for the (already-resident) arena in one
+  // bulk operation, so puts/reads never pay per-page minor faults on
+  // fresh regions. The pages exist in page cache (creator pre-faulted),
+  // so this is fast; best-effort on older kernels.
+  madvise(base, (size_t)st.st_size, MADV_POPULATE_WRITE);
+#endif
   if (size_out) *size_out = (uint64_t)st.st_size;
   return base;
 }
