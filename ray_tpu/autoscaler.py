@@ -61,6 +61,248 @@ class LocalNodeProvider(NodeProvider):
         return handle.node_id
 
 
+class SliceProvider:
+    """TPU-slice provider contract (parity: reference
+    ``autoscaler/batching_node_provider.py`` — declarative batch
+    provisioning): a slice is an ATOMIC group of N hosts (a TPU pod
+    slice's workers come up together via GKE/QueuedResources or not at
+    all). ``create_slice`` either yields all hosts or raises having
+    cleaned up."""
+
+    hosts_per_slice: int = 1
+    # concrete providers must set an INSTANCE dict of per-host resources
+    host_resources: Optional[Dict[str, float]] = None
+
+    def create_slice(self) -> Any:
+        raise NotImplementedError
+
+    def terminate_slice(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def non_terminated_slices(self) -> List[Any]:
+        raise NotImplementedError
+
+    def node_ids_of(self, handle: Any) -> List[bytes]:
+        raise NotImplementedError
+
+
+class FakeTpuPodProvider(SliceProvider):
+    """Fake TPU-pod provider (parity: reference
+    ``fake_multi_node/node_provider.py:237``): a 'slice' is
+    ``hosts_per_slice`` raylet processes on this host, created atomically
+    against one ``cluster_utils.Cluster`` — the cloud-free harness for
+    slice-granular autoscaling."""
+
+    def __init__(self, cluster, hosts_per_slice: int = 2,
+                 host_resources: Optional[Dict[str, float]] = None):
+        self.cluster = cluster
+        self.hosts_per_slice = hosts_per_slice
+        self.host_resources = dict(host_resources or {"CPU": 2, "TPU": 4})
+        self._slices: List[List] = []
+
+    def create_slice(self):
+        nodes = []
+        try:
+            for _ in range(self.hosts_per_slice):
+                nodes.append(
+                    self.cluster.add_node(resources=dict(self.host_resources))
+                )
+        except Exception:
+            for n in nodes:  # atomicity: all hosts or none
+                try:
+                    self.cluster.remove_node(n)
+                except Exception:
+                    pass
+            raise
+        self._slices.append(nodes)
+        return nodes
+
+    def terminate_slice(self, handle) -> None:
+        if handle in self._slices:
+            self._slices.remove(handle)
+        for n in handle:
+            try:
+                self.cluster.remove_node(n)
+            except Exception:
+                pass
+
+    def non_terminated_slices(self) -> List:
+        return list(self._slices)
+
+    def node_ids_of(self, handle) -> List[bytes]:
+        return [n.node_id for n in handle]
+
+
+def _collect_node_views(gcs) -> Dict[str, Dict]:
+    """node-id-hex -> raylet node_stats for every alive node (shared by
+    both autoscalers)."""
+    import ray_tpu._private.rpc as rpc_mod
+
+    views: Dict[str, Dict] = {}
+    try:
+        nodes = [n for n in gcs.call("get_all_nodes", None)
+                 if n.get("alive", True)]
+    except Exception:
+        return views
+    for n in nodes:
+        try:
+            client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
+            views[bytes(n["node_id"]).hex()] = client.call(
+                "node_stats", None, timeout=5
+            )
+            client.close()
+        except Exception:
+            continue
+    return views
+
+
+class TpuSliceAutoscaler:
+    """Slice-granular autoscaling: scale-up decisions count PENDING
+    placement groups (the gang-scheduling demand signal — a JaxTrainer
+    worker group arrives as one STRICT_SPREAD PG) plus plain unmet
+    resource demand, and provision WHOLE slices; scale-down reaps slices
+    whose every host has been idle past the timeout. Parity: reference
+    StandardAutoscaler's pending-PG handling (autoscaler.py:166) at
+    slice granularity."""
+
+    def __init__(
+        self,
+        provider: SliceProvider,
+        *,
+        max_slices: int = 2,
+        min_slices: int = 0,
+        idle_timeout_s: float = 10.0,
+    ):
+        self.provider = provider
+        self.max_slices = max_slices
+        self.min_slices = min_slices
+        self.idle_timeout_s = idle_timeout_s
+        self._idle_since: Dict[frozenset, float] = {}  # node-id set -> ts
+        # PG id -> slices already launched for it while it was pending:
+        # a slice takes minutes to come up on real clouds, and every
+        # reconcile poll must not re-launch for the same pending gang
+        self._provisioned_pgs: Dict[bytes, int] = {}
+        self.num_slice_launches = 0
+        self.num_slice_terminations = 0
+
+    def _host_fits(self, bundle: Dict[str, float]) -> bool:
+        res = self.provider.host_resources
+        return all(res.get(r, 0.0) >= q for r, q in bundle.items())
+
+    def _hosts_for(self, pg: Dict) -> Optional[int]:
+        """Hosts a pending PG needs on this provider's host shape; None =
+        unsatisfiable by any number of slices (never provision for it)."""
+        bundles = pg.get("bundles") or []
+        if not all(self._host_fits(b) for b in bundles):
+            return None
+        strategy = pg.get("strategy")
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            return len(bundles)
+        # PACK family: bundles may share hosts — size by summed demand
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for r, q in b.items():
+                total[r] = total.get(r, 0.0) + q
+        res = self.provider.host_resources
+        if strategy == "STRICT_PACK":
+            # all bundles must land on ONE host
+            if all(res.get(r, 0.0) >= q for r, q in total.items()):
+                return 1
+            return None
+        hosts = 1
+        for r, q in total.items():
+            per = res.get(r, 0.0)
+            if per > 0:
+                hosts = max(hosts, math.ceil(q / per))
+        return hosts
+
+    def update(self):
+        from ray_tpu._private.worker import require_connected
+
+        gcs = require_connected().gcs
+        # -- gang demand: pending PGs that a slice could satisfy --
+        slices_needed = 0
+        try:
+            pgs = gcs.call("placement_group_table", None)
+        except Exception:
+            pgs = []
+        if isinstance(pgs, dict):
+            pgs = list(pgs.values())
+        pending_ids = set()
+        for pg in pgs or []:
+            if pg.get("state") not in ("PENDING", "RESCHEDULING"):
+                continue
+            hosts = self._hosts_for(pg)
+            if hosts is None:
+                continue
+            pg_id = bytes(pg.get("pg_id") or b"")
+            pending_ids.add(pg_id)
+            want = math.ceil(hosts / self.provider.hosts_per_slice)
+            have = self._provisioned_pgs.get(pg_id, 0)
+            if want > have:
+                slices_needed += want - have
+                self._provisioned_pgs[pg_id] = want
+        # forget PGs that are no longer pending
+        for pid in [p for p in self._provisioned_pgs
+                    if p not in pending_ids]:
+            del self._provisioned_pgs[pid]
+        # -- plain unmet resource demand, in whole slices --
+        views = _collect_node_views(gcs)
+        unmet: Dict[str, float] = {}
+        for v in views.values():
+            for r, q in (v.get("demand") or {}).items():
+                unmet[r] = unmet.get(r, 0.0) + q
+        for v in views.values():
+            for r, q in (v.get("available") or {}).items():
+                unmet[r] = unmet.get(r, 0.0) - q
+        hosts_needed = 0
+        for r, q in unmet.items():
+            per_host = self.provider.host_resources.get(r, 0.0)
+            if q > 0 and per_host > 0:
+                hosts_needed = max(hosts_needed, math.ceil(q / per_host))
+        slices_needed += math.ceil(
+            hosts_needed / self.provider.hosts_per_slice
+        )
+        # -- scale up (atomic whole slices) --
+        live = self.provider.non_terminated_slices()
+        target_new = min(slices_needed, self.max_slices - len(live))
+        for _ in range(max(0, target_new)):
+            self.provider.create_slice()
+            self.num_slice_launches += 1
+        while len(self.provider.non_terminated_slices()) < self.min_slices:
+            self.provider.create_slice()
+            self.num_slice_launches += 1
+        # -- scale down: slices whose EVERY host is idle --
+        now = time.monotonic()
+        live_keys = set()
+        for handle in list(self.provider.non_terminated_slices()):
+            key = frozenset(self.provider.node_ids_of(handle))
+            live_keys.add(key)
+            all_idle = True
+            for nid in self.provider.node_ids_of(handle):
+                view = views.get(nid.hex())
+                if view is None or view.get("demand") or (
+                    view.get("available") != view.get("total")
+                ):
+                    all_idle = False
+                    break
+            if not all_idle:
+                self._idle_since.pop(key, None)
+                continue
+            first = self._idle_since.setdefault(key, now)
+            if (
+                now - first > self.idle_timeout_s
+                and len(self.provider.non_terminated_slices())
+                > self.min_slices
+            ):
+                self.provider.terminate_slice(handle)
+                self._idle_since.pop(key, None)
+                self.num_slice_terminations += 1
+        # drop stale idle entries for slices terminated out from under us
+        for key in [k for k in self._idle_since if k not in live_keys]:
+            del self._idle_since[key]
+
+
 class StandardAutoscaler:
     """Scale worker nodes of ONE node type between min and max by unmet
     resource demand; reap nodes idle past the timeout."""
@@ -91,22 +333,9 @@ class StandardAutoscaler:
 
     def update(self):
         from ray_tpu._private.worker import require_connected
-        import ray_tpu._private.rpc as rpc_mod
 
         gcs = require_connected().gcs
-        nodes = {bytes(n["node_id"]): n for n in gcs.call("get_all_nodes", None)
-                 if n.get("alive", True)}
-        # resource/demand view (heartbeat-carried)
-        views: Dict[str, Dict] = {}
-        for n in nodes.values():
-            try:
-                client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
-                stats = client.call("node_stats", None, timeout=5)
-                client.close()
-                views[bytes(n["node_id"]).hex()] = stats
-            except Exception:
-                continue
-
+        views = _collect_node_views(gcs)
         total_demand: Dict[str, float] = {}
         total_avail: Dict[str, float] = {}
         for v in views.values():

@@ -207,3 +207,56 @@ def test_prometheus_metrics_endpoint(rt_plat):
         assert 'prom_lat_count' in text
     finally:
         stop_dashboard()
+
+
+def test_tpu_slice_autoscaler_gang_places_pg():
+    """VERDICT r3 item 10: a pending 2-host STRICT_SPREAD placement group
+    (the JaxTrainer worker-group shape) triggers atomic provisioning of a
+    fake TPU slice; the PG then places, and the idle slice is reaped
+    after the work is gone."""
+    import time as _time
+
+    from ray_tpu.autoscaler import FakeTpuPodProvider, TpuSliceAutoscaler
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"resources": {"CPU": 2}})
+    c.connect()
+    try:
+        provider = FakeTpuPodProvider(
+            c, hosts_per_slice=2,
+            host_resources={"CPU": 2, "slicehost": 1},
+        )
+        scaler = TpuSliceAutoscaler(provider, max_slices=2,
+                                    idle_timeout_s=1.5)
+        # gang request: 2 bundles that ONLY slice hosts can satisfy
+        pg = placement_group(
+            [{"slicehost": 1}, {"slicehost": 1}], strategy="STRICT_SPREAD"
+        )
+        assert not pg.wait(timeout_seconds=2.0)  # pending: no slice yet
+        scaler.update()
+        assert scaler.num_slice_launches == 1
+        assert len(provider.non_terminated_slices()) == 1
+        # reconcile again while the PG may STILL be pending: no duplicate
+        # launch for an already-provisioned gang (real slices take minutes)
+        scaler.update()
+        assert scaler.num_slice_launches == 1
+        assert pg.wait(timeout_seconds=60.0)  # gang-placed on the slice
+        # no provisioning for the now-created PG either
+        scaler.update()
+        assert scaler.num_slice_launches == 1
+        # release the PG; the slice idles out and is terminated whole
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        remove_placement_group(pg)
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            scaler.update()
+            if scaler.num_slice_terminations == 1:
+                break
+            _time.sleep(0.5)
+        assert scaler.num_slice_terminations == 1
+        assert len(provider.non_terminated_slices()) == 0
+    finally:
+        c.shutdown()
