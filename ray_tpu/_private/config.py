@@ -91,6 +91,9 @@ _d("lineage_pinning_enabled", bool, True)
 # unconsumed by the caller (parity: reference
 # _generator_backpressure_num_objects)
 _d("streaming_generator_backpressure_items", int, 8)
+# cross-process span propagation in task metadata (reference
+# RAY_TRACING_ENABLED / tracing_helper.py:322)
+_d("tracing_enabled", bool, False)
 _d("max_lineage_bytes", int, 1024**3)
 _d("prestart_workers", bool, True)
 _d("worker_pool_min_idle", int, 0)

@@ -30,6 +30,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc, serialization
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef, install_ref_hooks
@@ -1025,6 +1026,10 @@ class CoreWorker:
             owner=self.address.to_wire(),
             scheduling_strategy=scheduling_strategy,
             runtime_env=self._process_runtime_env(runtime_env),
+            trace_ctx=(
+                _tracing.ctx_for_submit(task_id.binary())
+                if GLOBAL_CONFIG.tracing_enabled else None
+            ),
         )
         refs = []
         for oid in spec.return_ids():
@@ -1071,6 +1076,10 @@ class CoreWorker:
             "actor_id": spec.actor_id,
             "error": error,
         }
+        if spec.trace_ctx:
+            ev["trace_id"], ev["parent_span_id"], ev["span_id"] = (
+                spec.trace_ctx
+            )
         with self._task_event_lock:
             self._task_events.append(ev)
             flush_due = (
@@ -1445,6 +1454,10 @@ class CoreWorker:
             max_concurrency=max_concurrency,
             scheduling_strategy=scheduling_strategy,
             runtime_env=self._process_runtime_env(runtime_env),
+            trace_ctx=(
+                _tracing.ctx_for_submit(task_id.binary())
+                if GLOBAL_CONFIG.tracing_enabled else None
+            ),
         )
         wire = spec.to_wire()
         wire["name_register"] = actor_name
@@ -1481,6 +1494,10 @@ class CoreWorker:
             actor_id=actor_id,
             method_name=method_name,
             seq_no=self._actor_seq[actor_id],
+            trace_ctx=(
+                _tracing.ctx_for_submit(task_id.binary())
+                if GLOBAL_CONFIG.tracing_enabled else None
+            ),
         )
         refs = []
         for oid in spec.return_ids():
@@ -1854,6 +1871,11 @@ class CoreWorker:
                 )
             async with self._actor_aio_sem:
                 self._emit_task_event(spec, "RUNNING")
+                if spec.trace_ctx:
+                    # per-asyncio-task context: nested submits inherit
+                    _tracing.set_current(
+                        (spec.trace_ctx[0], spec.trace_ctx[2])
+                    )
                 try:
                     method = getattr(self._actor_instance, spec.method_name)
                     args, kwargs = self._unpack_args(self._decode_args(spec))
@@ -2036,6 +2058,12 @@ class CoreWorker:
     def _execute(self, spec: TaskSpec) -> Dict:
         self._current_task_name = spec.name
         self._emit_task_event(spec, "RUNNING")
+        trace_token = None
+        if spec.trace_ctx:
+            # nested submits from the user function inherit this trace
+            trace_token = _tracing.set_current(
+                (spec.trace_ctx[0], spec.trace_ctx[2])
+            )
         try:
             if spec.actor_creation:
                 # actor runtime env persists for the actor's lifetime
@@ -2082,6 +2110,8 @@ class CoreWorker:
             return self._error_reply(spec, e)
         finally:
             self._current_task_name = ""
+            if trace_token is not None:
+                _tracing.reset(trace_token)
 
     def _error_reply(self, spec: TaskSpec, e: BaseException) -> Dict:
         tb = traceback.format_exc()

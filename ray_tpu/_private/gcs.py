@@ -892,6 +892,10 @@ class GcsServer:
                     "error": "",
                     "attempts": 0,
                 }
+                if ev.get("trace_id"):
+                    rec["trace_id"] = ev["trace_id"]
+                    rec["parent_span_id"] = ev.get("parent_span_id", "")
+                    rec["span_id"] = ev.get("span_id", "")
                 self.task_events[tid] = rec
             state = ev["state"]
             if state == "RUNNING":

@@ -58,6 +58,9 @@ class TaskSpec:
     placement_group: Optional[bytes] = None
     pg_bundle_index: int = -1
     runtime_env: Optional[Dict] = None
+    # [trace_id, parent_span_id, span_id] when tracing is enabled
+    # (parity: reference tracing_helper.py:322 span context in metadata)
+    trace_ctx: Optional[List[str]] = None
 
     def to_wire(self) -> Dict:
         return dataclasses.asdict(self)

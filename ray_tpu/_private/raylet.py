@@ -827,14 +827,15 @@ class Raylet:
         # entry of that kind fails too — skip them wholesale so the pump
         # is O(grants), not O(queue), per call (a 100k-deep queue would
         # otherwise make each task completion scan the whole queue).
-        no_worker_kinds: Set[bool] = set()
+        kind_deficit: Dict[bool, int] = {}
         for summary, fut, conn in self.lease_queue:
             if fut.done():
                 continue
             resources = summary.get("resources") or {}
             tpu_needed = resources.get("TPU", 0) > 0
-            if tpu_needed in no_worker_kinds:
+            if tpu_needed in kind_deficit:
                 remaining.append((summary, fut, conn))
+                kind_deficit[tpu_needed] += 1
                 continue
             if not self._can_acquire(summary):
                 remaining.append((summary, fut, conn))
@@ -842,8 +843,7 @@ class Raylet:
             w = self._pop_idle_worker(tpu_needed)
             if w is None:
                 remaining.append((summary, fut, conn))
-                self._maybe_spawn_worker(tpu_needed)
-                no_worker_kinds.add(tpu_needed)
+                kind_deficit[tpu_needed] = 1
                 continue
             alloc = self._try_acquire(summary)
             if alloc is None:  # e.g. bundle pool exhausted while queued
@@ -864,6 +864,15 @@ class Raylet:
                 }
             )
         self.lease_queue = remaining
+        # Spawn toward the deficit ONCE per pump, outside the scan (the
+        # scan itself stays O(grants)): one spawn call per unsatisfied
+        # entry up to a small bound — _maybe_spawn_worker enforces the
+        # real CPU-slot cap internally. Without this, a mass worker death
+        # (chaos kills) respawned only one worker per pump and the pool
+        # never recovered ahead of the killer.
+        for kind, n in kind_deficit.items():
+            for _ in range(min(n, 32)):
+                self._maybe_spawn_worker(kind)
 
     def _pop_idle_worker(self, tpu: bool = False) -> Optional[WorkerHandle]:
         for i in range(len(self.idle) - 1, -1, -1):

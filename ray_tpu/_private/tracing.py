@@ -1,0 +1,52 @@
+"""Cross-process trace-context propagation for tasks/actors.
+
+Parity: reference ``python/ray/util/tracing/tracing_helper.py:322``
+(``_inject_tracing_into_function`` — OpenTelemetry span context riding in
+task metadata). Here the context is a (trace_id, span_id) pair carried on
+the TaskSpec wire: a submit inherits the submitting code's trace, the
+executor installs the task's own span for the duration of execution, so
+nested submits chain parent spans across processes. Span data lands in
+the task-event stream (GCS task manager) and comes back out through
+``ray_tpu.util.state.list_tasks`` / the chrome timeline.
+
+Opt-in via ``tracing_enabled`` (reference RAY_TRACING_ENABLED).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import List, Optional, Tuple
+
+# (trace_id, span_id) of the currently executing task (or a root set by
+# the driver); ContextVar so both threaded and asyncio actors isolate it
+_current: contextvars.ContextVar[Optional[Tuple[str, str]]] = (
+    contextvars.ContextVar("raytpu_trace_ctx", default=None)
+)
+
+
+def current() -> Optional[Tuple[str, str]]:
+    return _current.get()
+
+
+def set_current(ctx: Optional[Tuple[str, str]]):
+    return _current.set(ctx)
+
+
+def reset(token) -> None:
+    _current.reset(token)
+
+
+def span_for_task(task_id: bytes) -> str:
+    return task_id.hex()[:16]
+
+
+def ctx_for_submit(task_id: bytes) -> List[str]:
+    """Wire context for a task being submitted from the current scope:
+    [trace_id, parent_span_id, own_span_id]."""
+    cur = current()
+    if cur is None:
+        trace_id, parent = os.urandom(16).hex(), ""
+    else:
+        trace_id, parent = cur
+    return [trace_id, parent, span_for_task(task_id)]

@@ -48,6 +48,11 @@ def list_tasks(
                 "events": dict(r["states"]),
             }
         )
+        if r.get("trace_id"):
+            # present only when tracing_enabled: cross-process span chain
+            out[-1]["trace_id"] = r["trace_id"]
+            out[-1]["parent_span_id"] = r.get("parent_span_id", "")
+            out[-1]["span_id"] = r.get("span_id", "")
     return out
 
 

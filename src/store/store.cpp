@@ -378,13 +378,11 @@ void* rt_store_attach(const char* path, uint64_t* size_out) {
   close(fd);
   if (base == MAP_FAILED) return nullptr;
   if (H(base)->magic != kMagic) { munmap(base, (size_t)st.st_size); return nullptr; }
-#ifdef MADV_POPULATE_WRITE
-  // Build this process's PTEs for the (already-resident) arena in one
-  // bulk operation, so puts/reads never pay per-page minor faults on
-  // fresh regions. The pages exist in page cache (creator pre-faulted),
-  // so this is fast; best-effort on older kernels.
-  madvise(base, (size_t)st.st_size, MADV_POPULATE_WRITE);
-#endif
+  // NO attach-side pre-fault: bulk PTE setup for a multi-GiB arena adds
+  // ~O(seconds) to every WORKER spawn, which breaks recovery when workers
+  // must respawn fast (chaos kills — measured: the pool never caught up
+  // with a 0.4s-interval killer). Attachers take cheap per-page minor
+  // faults instead (pages are resident from the creator's pre-fault).
   if (size_out) *size_out = (uint64_t)st.st_size;
   return base;
 }

