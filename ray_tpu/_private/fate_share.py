@@ -1,0 +1,32 @@
+"""Daemon fate-sharing with the launching process.
+
+The simulated-cluster world (cluster_utils / node.py) spawns GCS + raylet
+daemons as children of the driver; a SIGKILLed driver (crashed test,
+aborted run) must not strand daemons holding multi-GiB shared-memory
+stores forever (observed: dozens of leaked raylets pinning ~70 GB of
+tmpfs across a day of test runs). Linux ``PR_SET_PDEATHSIG`` delivers
+SIGTERM the moment the parent dies — graceful daemon shutdown unlinks
+the store. Opt out with RAYTPU_NO_FATE_SHARE=1 for detached production
+daemons managed by a supervisor.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import signal
+
+PR_SET_PDEATHSIG = 1
+
+
+def fate_share_with_parent():
+    if os.environ.get("RAYTPU_NO_FATE_SHARE") == "1":
+        return
+    try:
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+        # the parent may have died between our fork and the prctl
+        if os.getppid() == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+    except Exception:
+        pass  # non-Linux / restricted: daemons simply don't fate-share

@@ -948,6 +948,9 @@ def main():
     import argparse
     import sys
 
+    from ray_tpu._private.fate_share import fate_share_with_parent
+
+    fate_share_with_parent()
     p = argparse.ArgumentParser()
     p.add_argument("--sock")
     p.add_argument("--config", default="")

@@ -1431,6 +1431,10 @@ def main():
     import argparse
     import json
 
+    from ray_tpu._private.fate_share import fate_share_with_parent
+
+    fate_share_with_parent()
+
     p = argparse.ArgumentParser()
     p.add_argument("--sock")
     p.add_argument("--store")
@@ -1449,6 +1453,20 @@ def main():
     if args.config:
         GLOBAL_CONFIG.load(json.loads(args.config))
 
+    # The shm store file must not outlive this raylet: when fate-sharing
+    # SIGTERMs us (driver died), the pre-faulted arena's committed pages
+    # would otherwise stay pinned in tmpfs until someone cleans /dev/shm.
+    import signal
+
+    def _unlink_store_and_exit(_sig, _frm):
+        try:
+            os.unlink(args.store)
+        except OSError:
+            pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _unlink_store_and_exit)
+
     async def run():
         raylet = Raylet(
             node_id=bytes.fromhex(args.node_id),
@@ -1462,7 +1480,13 @@ def main():
         await raylet.start()
         await asyncio.Event().wait()
 
-    asyncio.run(run())
+    try:
+        asyncio.run(run())
+    finally:
+        try:
+            os.unlink(args.store)
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":

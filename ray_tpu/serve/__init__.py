@@ -107,21 +107,52 @@ def _get_or_start_controller():
             return ray_tpu.get_actor(CONTROLLER_NAME)  # lost the race
 
 
-def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
-    """Deploy (or redeploy) and return a handle once replicas exist."""
-    controller = _get_or_start_controller()
+def _resolve_bound_deps(controller, value):
+    """Model composition (parity: reference deployment graphs,
+    serve/deployment_graph.py + drivers.py DAGDriver): a bound Application
+    appearing in another deployment's init args is deployed first and
+    replaced by its DeploymentHandle, so the outer deployment calls the
+    inner one through the router like any client."""
+    if isinstance(value, Application):
+        return _run_app(controller, value, None)
+    if isinstance(value, (list, tuple)):
+        resolved = [_resolve_bound_deps(controller, v) for v in value]
+        if hasattr(value, "_fields"):  # namedtuple: positional ctor
+            return type(value)(*resolved)
+        return type(value)(resolved)
+    if isinstance(value, dict):
+        return {k: _resolve_bound_deps(controller, v)
+                for k, v in value.items()}
+    return value
+
+
+def _run_app(controller, app: Application,
+             name: Optional[str]) -> DeploymentHandle:
     dep = app.deployment
+    init_args = tuple(
+        _resolve_bound_deps(controller, a) for a in app.init_args
+    )
+    init_kwargs = {
+        k: _resolve_bound_deps(controller, v)
+        for k, v in (app.init_kwargs or {}).items()
+    }
     ray_tpu.get(
         controller.deploy.remote(
             name or dep.name,
             dep._constructor,
-            app.init_args,
-            app.init_kwargs,
+            init_args,
+            init_kwargs,
             dep.config,
         ),
         timeout=300,
     )
     return DeploymentHandle(controller, name or dep.name)
+
+
+def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle once replicas exist.
+    Bound Applications nested in init args deploy first (composition)."""
+    return _run_app(_get_or_start_controller(), app, name)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
@@ -149,6 +180,12 @@ def start_http_proxy(port: int = 0) -> str:
     return ray_tpu.get(_proxy.address.remote(), timeout=60)
 
 
+from ray_tpu.serve.multiplex import (  # noqa: F401,E402
+    get_multiplexed_model_id,
+    multiplexed,
+)
+
+
 def __getattr__(name):
     # lazy: serve.LLMEngine / serve.LLMServer pull in jax only when used
     if name in ("LLMEngine", "LLMServer"):
@@ -161,5 +198,5 @@ def __getattr__(name):
 __all__ = [
     "deployment", "run", "delete", "status", "get_deployment_handle",
     "start_http_proxy", "Deployment", "Application", "DeploymentHandle",
-    "LLMEngine", "LLMServer",
+    "LLMEngine", "LLMServer", "multiplexed", "get_multiplexed_model_id",
 ]

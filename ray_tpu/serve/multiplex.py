@@ -1,0 +1,96 @@
+"""Model multiplexing: many models behind one deployment's replicas.
+
+Parity: reference ``python/ray/serve/multiplex.py`` —
+``@serve.multiplexed`` wraps a per-model loader with a per-replica LRU
+(at most ``max_num_models_per_replica`` resident), and
+``serve.get_multiplexed_model_id()`` exposes the requested model id to
+the handler. The TPU use: one replica process holding N small adapters /
+LoRA heads over a shared base, swapping by id without replica churn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextvars
+import inspect
+import threading
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "raytpu_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request being handled (set by the deployment
+    when it calls its multiplexed loader)."""
+    return _current_model_id.get()
+
+
+class _Multiplexed:
+    """Per-instance LRU over loaded models; safe under threaded replicas."""
+
+    def __init__(self, loader: Callable, max_models: int):
+        self._loader = loader
+        self._max = max_models
+        self._cache: "collections.OrderedDict[str, Any]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.num_loads = 0  # observability / tests
+
+    def get(self, owner, model_id: str):
+        with self._lock:
+            if model_id in self._cache:
+                self._cache.move_to_end(model_id)
+                _current_model_id.set(model_id)
+                return self._cache[model_id]
+        # load OUTSIDE the lock (loads can be slow); last writer wins on a
+        # racing double-load of the same id
+        self.num_loads += 1
+        if inspect.iscoroutinefunction(self._loader):
+            model = asyncio.run(self._loader(owner, model_id))
+        else:
+            model = self._loader(owner, model_id)
+        with self._lock:
+            self._cache[model_id] = model
+            self._cache.move_to_end(model_id)
+            while len(self._cache) > self._max:
+                self._cache.popitem(last=False)  # evict LRU
+        _current_model_id.set(model_id)
+        return model
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for a deployment METHOD that loads a model by id:
+
+        @serve.deployment
+        class Multi:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                return load_adapter(model_id)
+
+            def __call__(self, model_id, x):
+                return self.get_model(model_id)(x)
+
+    Each replica keeps at most N models resident (LRU)."""
+    if max_num_models_per_replica < 1:
+        raise ValueError("max_num_models_per_replica must be >= 1")
+
+    def deco(loader: Callable):
+        state_attr = f"__raytpu_mux_{loader.__name__}"
+
+        def wrapper(self, model_id: str):
+            mux: Optional[_Multiplexed] = getattr(self, state_attr, None)
+            if mux is None:
+                # pass the real loader so iscoroutinefunction sees async
+                # defs (a wrapping lambda would hide them)
+                mux = _Multiplexed(loader, max_num_models_per_replica)
+                setattr(self, state_attr, mux)
+            return mux.get(self, model_id)
+
+        wrapper.__name__ = loader.__name__
+        return wrapper
+
+    return deco

@@ -21,6 +21,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 
@@ -314,13 +315,24 @@ int rt_store_init(const char* path, uint64_t size, uint64_t table_capacity) {
   close(fd);
   if (base == MAP_FAILED) return -errno;
 
-  // Pre-fault the whole arena ONCE at store creation: without this, the
-  // first put into each fresh region pays per-page allocation faults
-  // (~5x bandwidth loss on 16MB puts measured on tmpfs). BEST-EFFORT
-  // only: on a small /dev/shm (tiny container shm limits) POPULATE fails
-  // with ENOMEM and we keep the old lazy behavior — a manual touch loop
-  // here would SIGBUS past tmpfs capacity.
-  madvise(base, size, MADV_POPULATE_WRITE);
+  // Pre-fault the arena ONCE at store creation: without this, the first
+  // put into each fresh region pays per-page allocation faults (~5x
+  // bandwidth loss on 16MB puts measured on tmpfs). BOUNDED: pre-fault
+  // COMMITS the pages, so it is capped (default 1 GiB, override via
+  // RAYTPU_STORE_PREFAULT_MAX bytes; 0 disables) — a fleet of default
+  // 2 GiB stores in a test harness must not commit the host's tmpfs
+  // (observed: ~70 GB pinned by leaked stores). BEST-EFFORT: POPULATE
+  // failing (tiny container shm) just keeps lazy behavior.
+  uint64_t prefault_max = 1ull << 30;
+  if (const char* env = getenv("RAYTPU_STORE_PREFAULT_MAX")) {
+    prefault_max = strtoull(env, nullptr, 10);
+  }
+  uint64_t prefault = size < prefault_max ? size : prefault_max;
+  if (prefault > 0) {
+    // cap, don't skip: the first `prefault` bytes of a big store still
+    // serve most put traffic warm (allocator packs low offsets first)
+    madvise(base, prefault, MADV_POPULATE_WRITE);
+  }
 
   Header* h = H(base);
   memset(h, 0, sizeof(Header));

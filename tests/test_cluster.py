@@ -60,7 +60,7 @@ def test_spillback_when_local_full(cluster2):
         return ray_tpu.get_runtime_context().get_node_id()
 
     refs = [hold.remote() for _ in range(4)]
-    nodes = set(ray_tpu.get(refs, timeout=120))
+    nodes = set(ray_tpu.get(refs, timeout=240))
     assert len(nodes) == 2, f"expected both nodes used, got {nodes}"
 
 
@@ -108,7 +108,7 @@ def test_task_retry_on_node_death(cluster2):
     refs = [slow.remote() for _ in range(4)]  # spread across both nodes
     time.sleep(1.0)
     cluster2.remove_node(doomed)
-    out = ray_tpu.get(refs, timeout=120)
+    out = ray_tpu.get(refs, timeout=240)
     assert all(nid == cluster2.head_node.node_id.hex() for nid in out)
 
 
@@ -174,7 +174,7 @@ def test_lineage_reconstruction():
         doomed = next(n for n in (n_a, n_b) if n.node_id.hex() == holder_hex)
         c.remove_node(doomed)
         time.sleep(1)
-        out = ray_tpu.get(ref, timeout=120)
+        out = ray_tpu.get(ref, timeout=240)
         assert int(out[0]) == 9 and out.shape == (1 << 19,)
     finally:
         c.shutdown()

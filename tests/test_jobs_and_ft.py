@@ -160,3 +160,64 @@ def test_actor_survives_gcs_restart():
         assert ray_tpu.get(a.inc.remote(), timeout=60) == 3
     finally:
         c.shutdown()
+
+
+@pytest.mark.slow
+def test_daemons_fate_share_with_driver(tmp_path):
+    """A SIGKILLed driver must not strand GCS/raylet/worker daemons (they
+    hold multi-GiB shared-memory stores): PR_SET_PDEATHSIG fate-sharing
+    terminates the tree (observed failure mode: ~70GB of tmpfs pinned by
+    leaked raylets across a day of aborted runs)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    import re
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import time\nimport ray_tpu\nray_tpu.init(num_cpus=2)\n"
+        "print('UP', flush=True)\ntime.sleep(120)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    shm_before = set(os.listdir("/dev/shm"))
+    p = subprocess.Popen([sys.executable, str(script)],
+                         stdout=subprocess.PIPE, text=True, env=env)
+    session = None
+    try:
+        assert p.stdout.readline().strip() == "UP"
+        _time.sleep(2)
+
+        def daemons(token):
+            out = subprocess.run(["ps", "-wweo", "pid,args"],
+                                 capture_output=True, text=True).stdout
+            return [ln for ln in out.splitlines()
+                    if "-m ray_tpu._private" in ln
+                    and (token is None or token in ln)]
+
+        # scope to THIS driver's session (other suites may run daemons)
+        for ln in daemons(None):
+            m = re.search(r"session_\d+_[0-9a-f]+", ln)
+            if m:
+                session = m.group(0)
+                break
+        assert session, "no session token found in daemon cmdlines"
+        assert len(daemons(session)) >= 2  # gcs + raylet (+ workers)
+    finally:
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait()
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline and daemons(session):
+        _time.sleep(1)
+    assert daemons(session) == [], daemons(session)
+    # the raylet's shm store must be unlinked too (the leak that pins
+    # tmpfs): no NEW raytpu_* file survives this driver's death
+    leftover = [
+        f for f in set(os.listdir("/dev/shm")) - shm_before
+        if f.startswith("raytpu_")
+    ]
+    assert leftover == [], leftover
