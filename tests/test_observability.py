@@ -141,15 +141,18 @@ def test_chaos_worker_kills_tasks_survive():
         killer = ChaosKiller(c, kill_interval_s=0.4, seed=1).start()
         refs = [chunk.remote(i) for i in range(24)]
         # keep a background stream of kill targets flowing until the
-        # killer has actually landed one: on a loaded machine the main 24
-        # can finish before the first kill, which tested nothing
+        # killer has actually landed a few: on a loaded machine the main
+        # 24 can finish before the first kill, which tested nothing
         extra = []
         deadline = time.monotonic() + 90
-        while killer.kills == 0 and time.monotonic() < deadline:
+        while killer.kills < 2 and time.monotonic() < deadline:
             extra.append(chunk.remote(-1))
             time.sleep(0.2)
-        out = ray_tpu.get(refs, timeout=300)
+        # STOP the killer before collecting: the property under test is
+        # "kills during execution are recovered", not "progress is
+        # possible under an unending kill storm on a loaded machine"
         kills = killer.stop()
+        out = ray_tpu.get(refs, timeout=300)
         ray_tpu.get(extra, timeout=300)  # stragglers must also survive
         assert sorted(out) == list(range(24))
         assert kills >= 1, "chaos killer never fired within 90s"
