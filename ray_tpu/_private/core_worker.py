@@ -1104,9 +1104,13 @@ class CoreWorker:
 
     @staticmethod
     def _freeze(v):
-        return tuple(CoreWorker._freeze(x) for x in v) if isinstance(
-            v, (list, tuple)
-        ) else v
+        if isinstance(v, (list, tuple)):
+            return tuple(CoreWorker._freeze(x) for x in v)
+        if isinstance(v, dict):  # e.g. label-strategy constraint maps
+            return tuple(sorted(
+                (k, CoreWorker._freeze(x)) for k, x in v.items()
+            ))
+        return v
 
     def _lease_key(self, spec: TaskSpec) -> Tuple:
         # Leases are multiplexed only across tasks with identical resource

@@ -403,6 +403,39 @@ class GcsServer:
             if not soft:
                 return None  # hard affinity to a gone node: keep waiting
             # soft: fall through to default
+        if isinstance(strategy, (list, tuple)) and strategy and (
+            strategy[0] == "labels"
+        ):
+            from ray_tpu.util.scheduling_strategies import labels_match
+
+            hard, soft = strategy[1] or {}, strategy[2] or {}
+            # soft is BEST-EFFORT: prefer (soft-match, fits-available),
+            # then any hard-match that fits totals — never fail an actor
+            # because the preferred node is too small
+            best = None  # (rank, nid); lower rank wins
+            for nid, info in self.nodes.items():
+                if not info.alive or not labels_match(info.labels, hard):
+                    continue
+                res_view = self.node_resources.get(nid, {})
+                avail = res_view.get("available", {})
+                total = res_view.get("total", {})
+                fits_avail = all(
+                    avail.get(r, 0.0) >= q for r, q in resources.items()
+                )
+                fits_total = all(
+                    total.get(r, 0.0) >= q for r, q in resources.items()
+                )
+                if not fits_total:
+                    continue
+                rank = (
+                    0 if labels_match(info.labels, soft) and fits_avail
+                    else 1 if fits_avail
+                    else 2 if labels_match(info.labels, soft)
+                    else 3
+                )
+                if best is None or rank < best[0]:
+                    best = (rank, nid)
+            return best[1] if best else None  # None: keep waiting
         spread = strategy == "SPREAD"
         best, best_score = None, None
         for nid, info in self.nodes.items():

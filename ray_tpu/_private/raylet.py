@@ -254,6 +254,32 @@ class Raylet:
                 else:
                     remaining.append((summary, fut, deadline, conn))
                 continue
+            if isinstance(strategy, (list, tuple)) and strategy and (
+                strategy[0] == "labels"
+            ):
+                # Hard label constraints: only matching nodes qualify —
+                # the generic re-dispatch below would grant anywhere.
+                from ray_tpu.util.scheduling_strategies import labels_match
+
+                hard = strategy[1] or {}
+                if labels_match(self.labels, hard) and self._feasible(
+                    resources
+                ):
+                    self.lease_queue.append((summary, fut, conn))
+                    continue
+                match = next(
+                    (n for _s, nhex, n in self._label_candidates(
+                        resources, hard, strategy[2] or {}
+                    ) if nhex != me),
+                    None,
+                )
+                if match is not None:
+                    fut.set_result({"spillback": match["raylet_addr"]})
+                elif expire and now > deadline:
+                    fut.set_result({"infeasible": True})
+                else:
+                    remaining.append((summary, fut, deadline, conn))
+                continue
             # Local feasibility can change at runtime once placement-group
             # bundle reservation mutates total_resources.
             if self._feasible(resources):
@@ -577,6 +603,29 @@ class Raylet:
             self._release_resources(resources)
 
     # ------------- lease protocol -------------
+    def _label_candidates(self, resources: Dict, hard: Dict, soft: Dict):
+        """Alive, hard-label-matching nodes whose TOTAL resources cover
+        the request (an undersized match would ping-pong spillbacks),
+        soft matches first."""
+        from ray_tpu.util.scheduling_strategies import labels_match
+
+        cands = []
+        for nhex, node in self.cluster_nodes.items():
+            if not node.get("alive", True):
+                continue
+            labels = node.get("labels") or {}
+            if not labels_match(labels, hard):
+                continue
+            total = (self.cluster_resources.get(nhex) or {}).get(
+                "total", node.get("resources") or {}
+            )
+            if not all(total.get(r, 0.0) >= q
+                       for r, q in resources.items()):
+                continue
+            cands.append((labels_match(labels, soft), nhex, node))
+        cands.sort(key=lambda c: (not c[0],))
+        return cands
+
     async def rpc_request_worker_lease(self, conn, summary: Dict):
         """Grant a worker lease, queue, or spill to another node.
 
@@ -635,6 +684,39 @@ class Raylet:
                     self._watch_owner(conn)
                     return await fut
                 # soft: fall through
+
+        if isinstance(strategy, (list, tuple)) and strategy and (
+            strategy[0] == "labels"
+        ):
+            hard = strategy[1] or {}
+            soft = strategy[2] or {}
+            cands = self._label_candidates(resources, hard, soft)
+            my_labels = self.labels
+            from ray_tpu.util.scheduling_strategies import labels_match
+
+            me_hard = labels_match(my_labels, hard)
+            me_soft = me_hard and labels_match(my_labels, soft)
+            if me_hard and self._feasible(resources) and (
+                hops > 0  # spilled here: grant, don't ping-pong
+                or me_soft or not any(s for s, _h, _n in cands)
+            ):
+                fut = asyncio.get_running_loop().create_future()
+                self.lease_queue.append((summary, fut, conn))
+                self._watch_owner(conn)
+                self._pump_lease_queue()
+                return await fut
+            for _soft_ok, nhex, node in cands:
+                if nhex != me:
+                    return {"spillback": node["raylet_addr"]}
+            # no FEASIBLE matching node anywhere: park until one appears,
+            # expire to an explicit infeasible error
+            fut = asyncio.get_running_loop().create_future()
+            grace = GLOBAL_CONFIG.infeasible_task_grace_s
+            self.infeasible_queue.append(
+                (summary, fut, time.monotonic() + grace, conn)
+            )
+            self._watch_owner(conn)
+            return await fut
 
         if strategy == "SPREAD" and hops == 0:
             target = self._pick_spread_target(resources)

@@ -1,0 +1,199 @@
+"""multiprocessing.Pool drop-in over ray_tpu actors.
+
+Parity: reference ``python/ray/util/multiprocessing`` — a Pool whose
+workers are cluster actors, so existing ``multiprocessing`` code scales
+past one host by changing an import. Supported surface: ``map``,
+``map_async``, ``starmap``, ``imap``, ``imap_unordered``, ``apply``,
+``apply_async``, ``close``/``terminate``/``join``, context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import ray_tpu
+
+
+def _noop():
+    return None
+
+
+class _PoolWorker:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, fn, chunk, star: bool):
+        if star:
+            return [fn(*args) for args in chunk]
+        return [fn(x) for x in chunk]
+
+    def apply(self, fn, args, kwds):
+        return fn(*args, **(kwds or {}))
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult surface over object refs."""
+
+    def __init__(self, refs: List, flatten: bool, single: bool = False):
+        self._refs = refs
+        self._flatten = flatten
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        outs = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return outs[0]
+        if self._flatten:
+            return [x for chunk in outs for x in chunk]
+        return outs
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout, fetch_local=False)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0, fetch_local=False)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result not ready")
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: Tuple = (), *, num_cpus_per_worker: float = 1.0):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            try:
+                processes = max(
+                    1, int(ray_tpu.cluster_resources().get("CPU", 1))
+                )
+            except Exception:
+                processes = os.cpu_count() or 1
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._size = processes
+        cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(_PoolWorker)
+        self._actors = [cls.remote(initializer, initargs)
+                        for _ in range(processes)]
+        self._closed = False
+        self._rr = 0
+
+    # -- helpers --
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, -(-len(items) // (self._size * 4)))
+        return [items[i: i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _submit_chunks(self, fn, chunks, star: bool) -> List:
+        actors = itertools.cycle(self._actors)
+        return [next(actors).run_chunk.remote(fn, c, star) for c in chunks]
+
+    # -- map family --
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List:
+        return self.map_async(fn, iterable, chunksize).get(timeout=None)
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check()
+        refs = self._submit_chunks(fn, self._chunks(iterable, chunksize),
+                                   star=False)
+        return AsyncResult(refs, flatten=True)
+
+    def starmap(self, fn: Callable, iterable: Iterable[Tuple],
+                chunksize: Optional[int] = None) -> List:
+        self._check()
+        refs = self._submit_chunks(fn, self._chunks(iterable, chunksize),
+                                   star=True)
+        return AsyncResult(refs, flatten=True).get(timeout=None)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Ordered lazy iteration (per-chunk granularity)."""
+        self._check()
+        refs = self._submit_chunks(fn, self._chunks(iterable, chunksize),
+                                   star=False)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        """Yield results as chunks complete, in completion order."""
+        self._check()
+        pending = set(self._submit_chunks(
+            fn, self._chunks(iterable, chunksize), star=False
+        ))
+        while pending:
+            done, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                   timeout=None)
+            for ref in done:
+                pending.discard(ref)
+                yield from ray_tpu.get(ref)
+
+    # -- apply family --
+
+    def apply(self, fn: Callable, args: Tuple = (), kwds=None) -> Any:
+        return self.apply_async(fn, args, kwds).get(timeout=None)
+
+    def apply_async(self, fn: Callable, args: Tuple = (),
+                    kwds=None) -> AsyncResult:
+        self._check()
+        # round-robin: concurrent applies spread across the pool
+        actor = self._actors[self._rr % self._size]
+        self._rr += 1
+        return AsyncResult([actor.apply.remote(fn, args, kwds)],
+                           flatten=False, single=True)
+
+    # -- lifecycle --
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+    def join(self):
+        """Wait for all in-flight work (stdlib close()/join() semantics:
+        outstanding submissions complete), then release the actors. The
+        per-actor FIFO means a no-op barrier call drains everything
+        submitted before it."""
+        if not self._closed:
+            raise ValueError("join() before close()")
+        if self._actors:
+            ray_tpu.get(
+                [a.apply.remote(_noop, (), None) for a in self._actors],
+                timeout=None,
+            )
+        self.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -37,6 +37,46 @@ class NodeAffinitySchedulingStrategy:
                 f"soft={self.soft})")
 
 
+class NodeLabelSchedulingStrategy:
+    """Place on nodes matching label constraints (parity: reference
+    ``NodeLabelSchedulingStrategy:135``).
+
+    ``hard``: {label_key: [allowed values]} — the node MUST match (no
+    matching alive node = infeasible after the grace window).
+    ``soft``: preferences among the hard-matching nodes (best effort)."""
+
+    def __init__(self, hard: Optional[dict] = None,
+                 soft: Optional[dict] = None):
+        if not hard and not soft:
+            raise ValueError("need at least one of hard/soft constraints")
+
+        def norm(req, which):
+            out = {}
+            for k, v in (req or {}).items():
+                if isinstance(v, str):
+                    # list('tpu-v5e') would silently become characters
+                    raise TypeError(
+                        f"{which}[{k!r}] must be a LIST of allowed values,"
+                        f" got the string {v!r} (wrap it: [{v!r}])"
+                    )
+                out[k] = list(v)
+            return out
+
+        self.hard = norm(hard, "hard")
+        self.soft = norm(soft, "soft")
+
+    def to_wire(self):
+        return ["labels", self.hard, self.soft]
+
+    def __repr__(self):
+        return f"NodeLabelSchedulingStrategy(hard={self.hard}, soft={self.soft})"
+
+
+def labels_match(labels: Optional[dict], req: dict) -> bool:
+    labels = labels or {}
+    return all(labels.get(k) in vals for k, vals in req.items())
+
+
 class PlacementGroupSchedulingStrategy:
     """Run inside a placement group's reserved bundle(s).
 

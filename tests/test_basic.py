@@ -375,3 +375,43 @@ def test_queue_parks_blocked_waiters(rt):
     assert time.monotonic() - t0 > 0.5
     t2.join(timeout=30)
     assert qb.get(timeout=10) == 2
+
+
+def test_multiprocessing_pool_shim(rt):
+    """multiprocessing.Pool drop-in over actors (reference
+    ray.util.multiprocessing): map/starmap/imap/apply + async variants."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=2) as pool:
+        assert pool.map(square, range(20)) == [x * x for x in range(20)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(pool.imap(square, range(8), chunksize=3)) == [
+            x * x for x in range(8)
+        ]
+        assert sorted(pool.imap_unordered(square, range(8))) == sorted(
+            x * x for x in range(8)
+        )
+        assert pool.apply(add, (20, 22)) == 42
+        r = pool.map_async(square, range(5))
+        r.wait(timeout=60)
+        assert r.ready() and r.get(timeout=10) == [0, 1, 4, 9, 16]
+
+    # initializer runs once per worker
+    def init_global(v):
+        import builtins
+
+        builtins._POOL_TEST_V = v
+
+    def read_global(_):
+        import builtins
+
+        return getattr(builtins, "_POOL_TEST_V", None)
+
+    with Pool(processes=2, initializer=init_global, initargs=(7,)) as pool:
+        assert pool.map(read_global, range(4)) == [7, 7, 7, 7]

@@ -415,3 +415,70 @@ def test_broadcast_pull_dedup():
         assert stats["objects_served"] <= 2, stats["objects_served"]
     finally:
         c.shutdown()
+
+
+def test_node_label_scheduling_strategy():
+    """NodeLabelSchedulingStrategy (reference scheduling_strategies.py:135):
+    hard label constraints pin work to matching nodes; soft constraints
+    prefer among them; no match = explicit infeasible error."""
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2},
+                        "labels": {"accel": "cpu"}},
+    )
+    try:
+        v5e = c.add_node(num_cpus=2, labels={"accel": "tpu-v5e",
+                                             "zone": "a"})
+        v5p = c.add_node(num_cpus=2, labels={"accel": "tpu-v5p",
+                                             "zone": "b"})
+        c.connect()
+
+        @ray_tpu.remote(num_cpus=1)
+        def where_am_i():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        # hard: any tpu node
+        strat = NodeLabelSchedulingStrategy(
+            hard={"accel": ["tpu-v5e", "tpu-v5p"]}
+        )
+        out = ray_tpu.get(
+            where_am_i.options(scheduling_strategy=strat).remote(),
+            timeout=60,
+        )
+        assert out in (v5e.node_id.hex(), v5p.node_id.hex())
+
+        # hard + soft: must be tpu, prefer zone b -> v5p
+        strat2 = NodeLabelSchedulingStrategy(
+            hard={"accel": ["tpu-v5e", "tpu-v5p"]}, soft={"zone": ["b"]}
+        )
+        out2 = ray_tpu.get(
+            where_am_i.options(scheduling_strategy=strat2).remote(),
+            timeout=60,
+        )
+        assert out2 == v5p.node_id.hex()
+
+        # actors honor labels through the GCS scheduler too
+        @ray_tpu.remote(num_cpus=1)
+        class Pinned:
+            def node(self):
+                return ray_tpu.get_runtime_context().get_node_id()
+
+        a = Pinned.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"accel": ["tpu-v5e"]}
+            )
+        ).remote()
+        assert ray_tpu.get(a.node.remote(), timeout=60) == v5e.node_id.hex()
+
+        # unmatched hard labels surface as an explicit failure
+        bad = where_am_i.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"accel": ["tpu-v9"]}
+            )
+        ).remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(bad, timeout=120)
+    finally:
+        c.shutdown()
