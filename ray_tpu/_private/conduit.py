@@ -1,0 +1,254 @@
+"""ctypes binding for the native conduit wire engine (src/conduit/conduit.cpp).
+
+Conduit owns the socket hot path — epoll, frame reassembly, coalesced
+writev — for processes that opt in (workers by default; see
+``core_worker``).  The frame protocol is identical to the asyncio
+transport in ``rpc.py`` ([u32 BE len][msgpack body]), so conduit servers
+interoperate with asyncio clients and vice versa.
+
+Parity: the completion-queue IO threads of the reference's C++ rpc layer
+(src/ray/rpc/grpc_server.h:55, client_call.h) feeding its core worker's
+task dispatch loop.
+
+Threading: one engine (epoll) thread + one reaper thread per process.
+The reaper drains event batches and invokes per-connection callbacks
+*on the reaper thread*; consumers decide where work goes from there
+(the worker's fast path enqueues straight to the execution queue,
+everything else hops to the asyncio loop).  ``send`` is safe from any
+thread and never blocks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Optional
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "src", "conduit", "conduit.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "ray_tpu", "_native")
+_LIB = os.path.join(_LIB_DIR, "_raytpu_conduit.so")
+
+_build_lock = threading.Lock()
+
+EV_FRAME = 0
+EV_ACCEPTED = 1
+EV_CLOSED = 2
+
+
+class _CdEvent(ctypes.Structure):
+    _fields_ = [
+        ("conn", ctypes.c_int64),
+        ("kind", ctypes.c_int32),
+        ("len", ctypes.c_uint32),
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("aux", ctypes.c_int64),
+    ]
+
+
+def _ensure_built() -> str:
+    with _build_lock:
+        if os.path.exists(_LIB) and (
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return _LIB
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        tmp = _LIB + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC, "-lpthread"],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_ensure_built())
+            lib.cd_engine_new.restype = ctypes.c_void_p
+            lib.cd_engine_stop.argtypes = [ctypes.c_void_p]
+            lib.cd_listen.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.cd_listen.restype = ctypes.c_int64
+            lib.cd_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.cd_connect.restype = ctypes.c_int64
+            lib.cd_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.cd_send.restype = ctypes.c_int64
+            lib.cd_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.cd_poll.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(_CdEvent), ctypes.c_int,
+            ]
+            lib.cd_poll.restype = ctypes.c_int
+            lib.cd_free.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)
+            ]
+            _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native engine can be built/loaded on this host."""
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+class Engine:
+    """One conduit engine: epoll thread (native) + reaper thread (here).
+
+    Callbacks registered per connection:
+      on_frame(conn_id, payload: bytes)   — reaper thread
+      on_close(conn_id)                   — reaper thread
+    Listeners get on_accept(conn_id) for inbound connections; the accept
+    callback must register the conn's callbacks before returning (frames
+    arriving before registration are queued briefly and replayed).
+    """
+
+    _instance: Optional["Engine"] = None
+    _ilock = threading.Lock()
+
+    POLL_BATCH = 512
+
+    def __init__(self):
+        self.lib = load()
+        self.h = self.lib.cd_engine_new()
+        self._cb_lock = threading.Lock()
+        self._on_frame: Dict[int, Callable] = {}
+        self._on_close: Dict[int, Callable] = {}
+        self._on_accept: Dict[int, Callable] = {}
+        self._orphans: Dict[int, list] = {}  # frames pre-registration
+        self._stopped = False
+        self._evbuf = (_CdEvent * self.POLL_BATCH)()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="conduit-reap", daemon=True
+        )
+        self._reaper.start()
+
+    @classmethod
+    def get(cls) -> "Engine":
+        with cls._ilock:
+            if cls._instance is None or cls._instance._stopped:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._ilock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.stop()
+
+    # ---- registration ----
+    def register(self, conn_id: int, on_frame, on_close=None):
+        with self._cb_lock:
+            self._on_frame[conn_id] = on_frame
+            if on_close is not None:
+                self._on_close[conn_id] = on_close
+            backlog = self._orphans.pop(conn_id, [])
+        for payload in backlog:
+            on_frame(conn_id, payload)
+
+    def listen(self, addr: str, on_accept) -> str:
+        """Returns the bound address (tcp port 0 resolved)."""
+        port = ctypes.c_int32(0)
+        lid = self.lib.cd_listen(
+            self.h, addr.encode(), ctypes.byref(port)
+        )
+        if lid < 0:
+            raise OSError(-lid, f"conduit listen failed on {addr}")
+        with self._cb_lock:
+            self._on_accept[lid] = on_accept
+        if addr.startswith("tcp:") and addr.rsplit(":", 1)[1] == "0":
+            host = addr[4:].rsplit(":", 1)[0]
+            return f"tcp:{host}:{port.value}"
+        return addr
+
+    def connect(self, addr: str) -> int:
+        cid = self.lib.cd_connect(self.h, addr.encode())
+        if cid < 0:
+            raise ConnectionError(f"conduit connect to {addr}: errno {-cid}")
+        return cid
+
+    def send(self, conn_id: int, payload: bytes) -> int:
+        """Queue one frame. Returns bytes queued on the conn (backpressure
+        signal), raises ConnectionError if the conn is gone."""
+        n = self.lib.cd_send(self.h, conn_id, payload, len(payload))
+        if n < 0:
+            raise ConnectionError(f"conduit conn {conn_id} closed")
+        return n
+
+    def close(self, conn_id: int):
+        self.lib.cd_close(self.h, conn_id)
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._reaper.join(timeout=5)
+        self.lib.cd_engine_stop(self.h)
+        self.h = None
+
+    # ---- reaper ----
+    def _reap_loop(self):
+        lib, h, buf = self.lib, self.h, self._evbuf
+        while not self._stopped:
+            n = lib.cd_poll(h, 200, buf, self.POLL_BATCH)
+            for i in range(n):
+                ev = buf[i]
+                if ev.kind == EV_FRAME:
+                    payload = ctypes.string_at(ev.data, ev.len)
+                    lib.cd_free(h, ev.data)
+                    with self._cb_lock:
+                        cb = self._on_frame.get(ev.conn)
+                        if cb is None:
+                            self._orphans.setdefault(ev.conn, []).append(
+                                payload
+                            )
+                            continue
+                    try:
+                        cb(ev.conn, payload)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                elif ev.kind == EV_ACCEPTED:
+                    with self._cb_lock:
+                        acb = self._on_accept.get(ev.aux)
+                    if acb is not None:
+                        try:
+                            acb(ev.conn)
+                        except Exception:
+                            import traceback
+
+                            traceback.print_exc()
+                elif ev.kind == EV_CLOSED:
+                    with self._cb_lock:
+                        self._on_frame.pop(ev.conn, None)
+                        ccb = self._on_close.pop(ev.conn, None)
+                        self._orphans.pop(ev.conn, None)
+                    if ccb is not None:
+                        try:
+                            ccb(ev.conn)
+                        except Exception:
+                            import traceback
+
+                            traceback.print_exc()
