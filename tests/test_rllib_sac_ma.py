@@ -1,0 +1,204 @@
+"""SAC (continuous control) and multi-agent PPO — VERDICT r3 item 6.
+
+Parity anchors: reference ``rllib/algorithms/sac/`` (twin critics,
+tanh-Gaussian actor, auto-alpha) and ``rllib/env/multi_agent_env.py``
+(dict-keyed API, policy_mapping_fn, shared policies).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_rl():
+    ray_tpu.init(num_cpus=3, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- SAC unit ----
+def test_squashed_gaussian_logp_matches_numeric():
+    """logp of the tanh-squashed Gaussian matches a numerical check of
+    the change-of-variables formula."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.sac import sample_squashed
+
+    rng = jax.random.key(0)
+    mu = jnp.array([[0.3, -1.2]])
+    log_std = jnp.array([[-0.5, 0.1]])
+    a, logp = sample_squashed(rng, mu, log_std)
+    assert a.shape == (1, 2) and bool(jnp.all(jnp.abs(a) < 1.0))
+    # recompute: u = atanh(a); logp = N(u) - sum log(1 - a^2)
+    u = jnp.arctanh(jnp.clip(a, -1 + 1e-6, 1 - 1e-6))
+    std = jnp.exp(log_std)
+    logp_u = (
+        -0.5 * (((u - mu) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+    ).sum(-1)
+    expected = logp_u - jnp.log(1 - a**2 + 1e-9).sum(-1)
+    np.testing.assert_allclose(
+        np.asarray(logp), np.asarray(expected), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_point_goal_env_api():
+    from ray_tpu.rllib.envs import make_env
+
+    env = make_env("PointGoal2D-v0")
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0.0
+    for _ in range(env.MAX_STEPS):
+        obs, r, term, trunc, _ = env.step(np.array([0.5, -0.5]))
+        total += r
+        assert not term
+    assert trunc  # fixed-horizon truncation
+    assert total < 0.0  # distance-penalty reward
+
+
+def test_sac_update_step_runs_and_targets_move():
+    """One jitted update: losses finite, polyak targets move toward the
+    online critics, alpha adapts."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.sac import SAC, SACConfig
+
+    cfg = SACConfig(num_workers=0, train_batches=4, batch_size=32,
+                    hidden=(32,), seed=0)
+    algo = object.__new__(SAC)
+    algo.config = cfg
+    import optax
+
+    from ray_tpu.rllib.sac import init_sac_networks
+
+    algo.params = init_sac_networks(jax.random.key(0), 4, 2, cfg.hidden)
+    algo.target_params = jax.tree.map(
+        lambda x: x, {"q1": algo.params["q1"], "q2": algo.params["q2"]}
+    )
+    algo.log_alpha = jnp.zeros(())
+    algo.target_entropy = -2.0
+    algo.opt = optax.adam(cfg.lr)
+    algo.opt_state = algo.opt.init(algo.params)
+    algo.alpha_opt = optax.adam(cfg.alpha_lr)
+    algo.alpha_opt_state = algo.alpha_opt.init(algo.log_alpha)
+    update = jax.jit(algo._make_update())
+
+    rng = np.random.default_rng(0)
+    batches = {
+        "obs": jnp.asarray(rng.random((4, 32, 4), np.float32)),
+        "actions": jnp.asarray(
+            rng.uniform(-1, 1, (4, 32, 2)).astype(np.float32)
+        ),
+        "rewards": jnp.asarray(rng.random((4, 32), np.float32)),
+        "next_obs": jnp.asarray(rng.random((4, 32, 4), np.float32)),
+        "terminals": jnp.zeros((4, 32), jnp.float32),
+    }
+    before = jax.device_get(algo.target_params["q1"][0]["w"])
+    (params, targets, log_alpha, _, _, closs, aloss) = update(
+        algo.params, algo.target_params, algo.log_alpha,
+        algo.opt_state, algo.alpha_opt_state, jax.random.key(1), batches,
+    )
+    assert np.isfinite(float(closs)) and np.isfinite(float(aloss))
+    after = jax.device_get(targets["q1"][0]["w"])
+    assert not np.allclose(before, after)  # polyak moved
+    assert float(log_alpha) != 0.0  # temperature adapted
+
+
+@pytest.mark.slow
+def test_sac_learns_point_goal(rt_rl):
+    """The 'done' bar: SAC crosses a reward threshold a random policy
+    cannot reach (random ~-40/episode on PointGoal2D; learned > -15)."""
+    from ray_tpu.rllib.sac import SACConfig
+
+    algo = SACConfig(
+        env="PointGoal2D-v0", num_workers=2, rollout_len=256,
+        learning_starts=512, train_batches=48, batch_size=128,
+        hidden=(64, 64), seed=0,
+    ).build()
+    try:
+        best = -1e9
+        for _ in range(40):
+            m = algo.train()
+            r = m["episode_reward_mean"]
+            if np.isfinite(r):
+                best = max(best, r)
+            if best > -15.0:
+                break
+        assert best > -15.0, f"SAC plateaued at {best:.1f}"
+    finally:
+        algo.stop()
+
+
+# ----------------------------------------------------------- multi-agent ----
+def test_two_agent_env_api():
+    import ray_tpu.rllib.multi_agent  # noqa: F401 — registers the env
+    from ray_tpu.rllib.envs import make_env
+
+    env = make_env("TwoAgentTarget-v0")
+    obs, _ = env.reset(seed=1)
+    assert set(obs) == {"a0", "a1"}
+    obs, rew, term, trunc, _ = env.step({"a0": 2, "a1": 0})
+    assert set(rew) == {"a0", "a1"}
+    assert term["__all__"] is False
+    for _ in range(env.N_STEPS):
+        obs, rew, term, trunc, _ = env.step({"a0": 1, "a1": 1})
+    assert trunc["__all__"] is True
+
+
+def test_multi_agent_rollout_per_policy_batches():
+    """Agents mapped to DIFFERENT policies produce separate batches;
+    shared mapping merges them (parameter sharing)."""
+    import jax
+
+    from ray_tpu.rllib.models import init_actor_critic
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    w = MultiAgentRolloutWorker(
+        "TwoAgentTarget-v0", rollout_len=48, gamma=0.99, lam=0.95,
+        policy_mapping={"a0": "p0", "a1": "p1"}, seed=0,
+    )
+    params = {
+        p: init_actor_critic(jax.random.key(i), 2, 3, (16,))
+        for i, p in enumerate(["p0", "p1"])
+    }
+    out = w.sample(params)
+    assert set(out["batches"]) == {"p0", "p1"}
+    assert out["batches"]["p0"]["obs"].shape == (48, 2)
+    shared = MultiAgentRolloutWorker(
+        "TwoAgentTarget-v0", rollout_len=48, gamma=0.99, lam=0.95,
+        policy_mapping={"a0": "shared", "a1": "shared"}, seed=0,
+    )
+    sparams = {"shared": params["p0"]}
+    sout = shared.sample(sparams)
+    # both agents' 48 steps land in ONE policy batch
+    assert sout["batches"]["shared"]["obs"].shape == (96, 2)
+
+
+@pytest.mark.slow
+def test_two_agent_ppo_learns(rt_rl):
+    """2-agent PPO (per-agent policies) improves the team reward well
+    past random (~-19/episode random; learned > -9)."""
+    from ray_tpu.rllib.multi_agent import MultiAgentPPOConfig
+
+    algo = MultiAgentPPOConfig(
+        env="TwoAgentTarget-v0",
+        policy_mapping_fn=lambda aid: f"pol_{aid}",
+        num_workers=2, rollout_len=384, sgd_epochs=6, seed=0,
+    ).build()
+    try:
+        best = -1e9
+        for _ in range(30):
+            m = algo.train()
+            r = m["episode_reward_mean"]
+            if np.isfinite(r):
+                best = max(best, r)
+            if best > -9.0:
+                break
+        assert best > -9.0, f"multi-agent PPO plateaued at {best:.1f}"
+        assert set(m["info"]) <= {"pol_a0", "pol_a1"}
+    finally:
+        algo.stop()
