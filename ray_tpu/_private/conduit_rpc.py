@@ -1,0 +1,266 @@
+"""Conduit-backed RPC server: the native wire engine serving a worker's
+task endpoint.
+
+Parity: the role of the reference's C++ core-worker gRPC server +
+completion-queue threads (src/ray/rpc/grpc_server.h:55,
+core_worker/core_worker.h task receiver): frames are parsed natively
+(src/conduit/conduit.cpp), and the push_task hot path goes
+reaper-thread → execution queue → exec thread → native send — zero
+asyncio machinery per call.  Every other method routes to the normal
+async handler table on the process IO loop, and the wire format is the
+one in rpc.py, so asyncio clients interoperate transparently.
+
+Threading map (worker process):
+  conduit engine thread  — epoll, framing, coalesced writev (C++)
+  conduit reaper thread  — msgpack decode, fast-path dispatch (here)
+  asyncio IO loop        — slow-path handlers, outgoing calls
+  exec thread            — user code; replies sent directly via cd_send
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+import msgpack
+
+from ray_tpu._private import conduit, rpc
+
+
+class OrderGate:
+    """Per-connection arrival-order release gate for ordered-actor pushes.
+
+    Entries are submitted in frame-arrival order (reaper thread).  An
+    entry runs (enqueues its task for execution) only when it reaches the
+    queue head AND is ready (args staged); the single exec thread then
+    serializes execution in release order = submission order.  Thread-
+    safe: submit() runs on the reaper thread, mark_ready() on the IO loop
+    after staging."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q: collections.deque = collections.deque()
+
+    def submit(self, run: Callable[[], None], ready: bool):
+        ent = {"run": run, "ready": ready}
+        with self._lock:
+            self._q.append(ent)
+        self._drain()
+        return ent
+
+    def mark_ready(self, ent):
+        with self._lock:
+            ent["ready"] = True
+        self._drain()
+
+    def abandon(self, ent):
+        """Staging failed: drop the entry so it can't wedge the queue."""
+        with self._lock:
+            try:
+                self._q.remove(ent)
+            except ValueError:
+                pass
+        self._drain()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if not self._q or not self._q[0]["ready"]:
+                    return
+                ent = self._q.popleft()
+            ent["run"]()
+
+
+class ConduitConnection:
+    """Inbound conduit connection duck-typing rpc.Connection for the
+    handler table (call_async / notify_async / add_close_callback /
+    closed / arbitrary attributes like the push-order gate)."""
+
+    def __init__(self, server: "ConduitRpcServer", conn_id: int):
+        self.server = server
+        self.engine = server.engine
+        self.conn_id = conn_id
+        self.loop = server.loop
+        self.name = f"{server.name}#{conn_id}"
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._close_callbacks: List = []
+        self.order_gate: Optional[OrderGate] = None  # lazily by fast path
+
+    # ---- outbound (any thread) ----
+    def send_frame(self, kind, seqno, method, data):
+        body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
+        try:
+            self.engine.send(self.conn_id, body)
+        except ConnectionError as e:
+            raise rpc.SendError(str(e)) from e
+
+    def reply_fn(self, seqno, method) -> Callable[[dict], None]:
+        """Thread-safe completion callback: the exec thread replies
+        straight into the native engine — no loop hop."""
+
+        def fn(reply):
+            try:
+                self.send_frame(rpc._REPLY, seqno, method, reply)
+            except Exception:
+                pass  # conn died; caller-side failure handling owns this
+
+        return fn
+
+    def task_done_fn(self, task_id: bytes) -> Callable[[dict], None]:
+        """Completion callback for STREAMED pushes: a task_done notify
+        keyed by task id (the caller correlates via its in-flight map)."""
+
+        def fn(reply):
+            try:
+                self.send_frame(
+                    rpc._NOTIFY, None, "task_done", [task_id, reply]
+                )
+            except Exception:
+                pass
+
+        return fn
+
+    # ---- rpc.Connection surface ----
+    async def call_async(self, method, data, timeout=None):
+        seqno = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seqno] = fut
+        try:
+            if self._closed:
+                raise rpc.SendError(f"connection {self.name} closed")
+            self.send_frame(rpc._REQUEST, seqno, method, data)
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(seqno, None)
+
+    async def notify_async(self, method, data):
+        self.send_frame(rpc._NOTIFY, None, method, data)
+
+    def add_close_callback(self, cb):
+        if self._closed:
+            cb(self)
+        else:
+            self._close_callbacks.append(cb)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def close(self):
+        self._do_close()
+
+    def _do_close(self):
+        if not self._closed:
+            self.engine.close(self.conn_id)
+
+    # ---- inbound (reaper thread) ----
+    def on_frame(self, payload: bytes):
+        kind, seqno, method, data = msgpack.unpackb(payload, raw=False)
+        if kind in (rpc._REPLY, rpc._ERROR):
+            self.loop.call_soon_threadsafe(self._resolve, kind, seqno, data)
+            return
+        fast = self.server.fast_dispatch
+        if fast is not None and fast(self, kind, seqno, method, data):
+            return
+        self.loop.call_soon_threadsafe(
+            self._spawn_handler, kind, seqno, method, data
+        )
+
+    def _resolve(self, kind, seqno, data):
+        fut = self._pending.pop(seqno, None)
+        if fut is not None and not fut.done():
+            if kind == rpc._REPLY:
+                fut.set_result(data)
+            else:
+                fut.set_exception(rpc.RpcError(data))
+
+    def _spawn_handler(self, kind, seqno, method, data):
+        self.loop.create_task(self._handle(kind, seqno, method, data))
+
+    async def _handle(self, kind, seqno, method, data):
+        try:
+            t0 = time.monotonic()
+            reply = await self.server.handler(self, method, data)
+            rpc.method_stats().record(
+                method, (time.monotonic() - t0) * 1e3
+            )
+            if kind == rpc._REQUEST:
+                self.send_frame(rpc._REPLY, seqno, method, reply)
+        except Exception:
+            if kind == rpc._REQUEST:
+                try:
+                    self.send_frame(
+                        rpc._ERROR, seqno, method, traceback.format_exc()
+                    )
+                except Exception:
+                    pass
+
+    def on_engine_close(self):
+        self._closed = True
+
+        def run_cbs():
+            for fut in list(self._pending.values()):
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError(f"connection {self.name} closed")
+                    )
+            self._pending.clear()
+            cbs, self._close_callbacks = self._close_callbacks, []
+            for cb in cbs:
+                try:
+                    cb(self)
+                except Exception:
+                    pass
+
+        self.loop.call_soon_threadsafe(run_cbs)
+
+
+class ConduitRpcServer:
+    """Drop-in for rpc.Server on a worker endpoint (same start_async /
+    stop_async / addr surface), with an optional ``fast_dispatch`` hook
+    the core worker installs for push_task.
+
+    The listener itself is never torn down (the engine keeps it until
+    process exit) — worker processes exit on shutdown, and the unix
+    socket path dies with the session directory."""
+
+    def __init__(self, addr: str, handler, name: str = "",
+                 fast_dispatch=None):
+        if ":" not in addr or addr.startswith("/"):
+            addr = "unix:" + addr
+        self.requested_addr = addr
+        self.addr = addr
+        self.handler = handler
+        self.name = name
+        self.fast_dispatch = fast_dispatch
+        self.engine = conduit.Engine.get()
+        self.loop = rpc.EventLoopThread.get().loop
+        self.connections: List[ConduitConnection] = []
+
+    async def start_async(self):
+        self.addr = self.engine.listen(self.requested_addr, self._on_accept)
+
+    def _on_accept(self, conn_id: int):  # reaper thread
+        conn = ConduitConnection(self, conn_id)
+        self.connections.append(conn)
+        conn.add_close_callback(
+            lambda c: self.connections.remove(c)
+            if c in self.connections else None
+        )
+        self.engine.register(
+            conn_id, lambda _cid, payload: conn.on_frame(payload),
+            on_close=lambda _cid: conn.on_engine_close(),
+        )
+
+    async def stop_async(self):
+        for c in list(self.connections):
+            c._do_close()

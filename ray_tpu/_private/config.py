@@ -102,9 +102,18 @@ _d("infeasible_task_grace_s", float, 30.0)
 _d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
 # outbound chunk-serve concurrency per raylet (push-manager pacing role)
 _d("object_transfer_max_concurrent_chunks", int, 4)
-# how many tasks an owner keeps in flight per lease (arg staging overlaps:
-# a slow-transfer task doesn't stall the lease pipeline)
-_d("lease_push_pipeline_depth", int, 2)
+# how many tasks an owner keeps in flight per lease. DEFAULT 1: a task
+# blocked in a nested get() must not strand tasks committed behind it on
+# the same serial worker (they would get their own leases instead).
+# Raise for flat data-parallel workloads (the perf bench uses 8) —
+# parity: reference max_tasks_in_flight_per_worker lease multiplexing.
+_d("lease_push_pipeline_depth", int, 1)
+# in-flight pushed calls per ordered actor (round 4 pipelined submitter;
+# the executor's per-caller ticket queue keeps execution submission-order)
+_d("actor_pipeline_depth", int, 256)
+# serve worker task endpoints through the native conduit wire engine
+# (src/conduit/conduit.cpp) when it builds; asyncio transport otherwise
+_d("native_wire", bool, True)
 # cap on concurrent lease requests per (resources, strategy) key: enough
 # to saturate a node's parallelism without parking one request per queued
 # task at the raylet (100k-deep queues)

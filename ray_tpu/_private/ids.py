@@ -10,12 +10,43 @@ prefix + 4-byte big-endian index.
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 
 _UNIQUE_LEN = 16
 _TASK_PREFIX_LEN = 12
 
 _NIL = b"\x00" * _UNIQUE_LEN
+
+
+class _PrefixCounter:
+    """Cheap unique 12-byte prefixes: one urandom seed per (process,
+    fork), then a counter — os.urandom per task id is measurable at
+    10k submissions/s. 6 random bytes namespace the process; 6 counter
+    bytes give 2^48 ids before wrap."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pid = None
+        self._seed = b""
+        self._count = None
+
+    def next_prefix(self) -> bytes:
+        pid = os.getpid()
+        with self._lock:
+            if pid != self._pid:  # new process/fork: fresh namespace
+                self._pid = pid
+                self._seed = os.urandom(6)
+                self._count = itertools.count(
+                    int.from_bytes(os.urandom(4), "big")
+                )
+            return self._seed + (
+                next(self._count) & 0xFFFFFFFFFFFF
+            ).to_bytes(6, "big")
+
+
+_prefixes = _PrefixCounter()
 
 
 class BaseID:
@@ -91,7 +122,7 @@ class TaskID(BaseID):
 
     @classmethod
     def for_task(cls) -> "TaskID":
-        return cls(os.urandom(_TASK_PREFIX_LEN) + b"\x00" * 4)
+        return cls(_prefixes.next_prefix() + b"\x00" * 4)
 
     def prefix(self) -> bytes:
         return self._binary[:_TASK_PREFIX_LEN]
@@ -106,7 +137,7 @@ class ObjectID(BaseID):
 
     @classmethod
     def for_put(cls) -> "ObjectID":
-        return cls(os.urandom(_TASK_PREFIX_LEN) + (0).to_bytes(4, "big"))
+        return cls(_prefixes.next_prefix() + (0).to_bytes(4, "big"))
 
     @classmethod
     def from_task(cls, task_id: TaskID, index: int) -> "ObjectID":

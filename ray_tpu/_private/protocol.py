@@ -63,7 +63,33 @@ class TaskSpec:
     trace_ctx: Optional[List[str]] = None
 
     def to_wire(self) -> Dict:
-        return dataclasses.asdict(self)
+        # hand-rolled shallow dict: dataclasses.asdict deep-copies every
+        # field (including packed arg bytes) — measurable on the submit
+        # hot path at 10k specs/s. msgpack serializes the shared
+        # references without needing the copy.
+        return {
+            "task_id": self.task_id,
+            "function_id": self.function_id,
+            "job_id": self.job_id,
+            "name": self.name,
+            "args": self.args,
+            "num_returns": self.num_returns,
+            "resources": self.resources,
+            "max_retries": self.max_retries,
+            "retry_exceptions": self.retry_exceptions,
+            "owner": self.owner,
+            "actor_id": self.actor_id,
+            "actor_creation": self.actor_creation,
+            "method_name": self.method_name,
+            "seq_no": self.seq_no,
+            "max_restarts": self.max_restarts,
+            "max_concurrency": self.max_concurrency,
+            "scheduling_strategy": self.scheduling_strategy,
+            "placement_group": self.placement_group,
+            "pg_bundle_index": self.pg_bundle_index,
+            "runtime_env": self.runtime_env,
+            "trace_ctx": self.trace_ctx,
+        }
 
     @classmethod
     def from_wire(cls, w: Dict) -> "TaskSpec":
@@ -80,8 +106,16 @@ class TaskSpec:
         # num_returns == -2 ("streaming" generator task): ONE return — the
         # completion object (yield count / error); the yields themselves
         # get deterministic ids via yield_object_id().
+        # Cached: called 3+ times per task on the submit/reply hot path,
+        # and task_id/num_returns never change after construction.
+        cached = getattr(self, "_return_ids", None)
+        if cached is not None:
+            return cached
         n = 1 if self.num_returns in (-1, -2) else self.num_returns
-        return [ObjectID.from_task(self.tid, i + 1) for i in range(n)]
+        self._return_ids = [
+            ObjectID.from_task(self.tid, i + 1) for i in range(n)
+        ]
+        return self._return_ids
 
 
 def yield_object_id(tid: "TaskID", index: int) -> ObjectID:

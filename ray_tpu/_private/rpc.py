@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import itertools
+import logging
 import threading
 import time
 import traceback
@@ -31,6 +32,7 @@ import msgpack
 _REQUEST, _REPLY, _ERROR, _NOTIFY = 0, 1, 2, 3
 
 _MAX_FRAME = 1 << 31
+_DRAIN_HIGH_WATER = 4 << 20  # bytes buffered before writers must drain
 
 
 def parse_addr(addr: str):
@@ -127,6 +129,9 @@ class Connection:
         self._close_callbacks: list = []
         self._read_task: Optional[asyncio.Task] = None
         self._write_lock = asyncio.Lock()
+        # method -> fn(conn, data): notifies dispatched INLINE in the read
+        # loop (no handler task) — the data-plane reply hot path
+        self.sync_notify: Dict[str, Callable] = {}
 
     def start(self):
         self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -146,9 +151,18 @@ class Connection:
                         self._handle(seqno, method, data)
                     )
                 elif kind == _NOTIFY:
-                    asyncio.get_running_loop().create_task(
-                        self._handle(None, method, data)
-                    )
+                    fn = self.sync_notify.get(method)
+                    if fn is not None:
+                        try:
+                            fn(self, data)
+                        except Exception:
+                            logging.getLogger(__name__).exception(
+                                "sync notify handler %s failed", method
+                            )
+                    else:
+                        asyncio.get_running_loop().create_task(
+                            self._handle(None, method, data)
+                        )
                 elif kind in (_REPLY, _ERROR):
                     fut = self._pending.pop(seqno, None)
                     if fut is not None and not fut.done():
@@ -176,13 +190,17 @@ class Connection:
                     pass
 
     async def _send(self, kind, seqno, method, data):
+        # Hot path: ONE buffer append per frame (the transport coalesces
+        # same-tick frames into one syscall) and drain only past the
+        # high-water mark — per-frame drain() costs a task switch each
+        # and throttled nothing below the watermark anyway.
         body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
-        async with self._write_lock:
-            if self._closed or self.writer.is_closing():
-                raise ConnectionError(f"connection {self.name} closed")
-            self.writer.write(len(body).to_bytes(4, "big"))
-            self.writer.write(body)
-            await self.writer.drain()
+        if self._closed or self.writer.is_closing():
+            raise ConnectionError(f"connection {self.name} closed")
+        self.writer.write(len(body).to_bytes(4, "big") + body)
+        if self.writer.transport.get_write_buffer_size() > _DRAIN_HIGH_WATER:
+            async with self._write_lock:
+                await self.writer.drain()
 
     async def call_async(self, method: str, data: Any, timeout=None) -> Any:
         seqno = next(self._seq)
@@ -201,6 +219,16 @@ class Connection:
 
     async def notify_async(self, method: str, data: Any):
         await self._send(_NOTIFY, None, method, data)
+
+    def send_notify(self, method: str, data: Any):
+        """Synchronous notify write (IO-loop thread only): one buffer
+        append, no future, no drain — the streaming data-plane send.
+        Callers bound in-flight volume (window semaphores), so transport
+        backpressure is handled at the protocol layer."""
+        body = msgpack.packb([_NOTIFY, None, method, data], use_bin_type=True)
+        if self._closed or self.writer.is_closing():
+            raise SendError(f"connection {self.name} closed")
+        self.writer.write(len(body).to_bytes(4, "big") + body)
 
     def add_close_callback(self, cb: Callable[["Connection"], None]):
         if self._closed:

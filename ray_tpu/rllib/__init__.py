@@ -13,7 +13,14 @@ actor-critic update (ppo.py).
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.learner_group import LearnerGroup  # noqa: F401
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 
 __all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-           "LearnerGroup"]
+           "SAC", "SACConfig", "MultiAgentEnv", "MultiAgentPPO",
+           "MultiAgentPPOConfig", "LearnerGroup"]

@@ -140,13 +140,16 @@ class SACConfig:
     num_workers: int = 2
     rollout_len: int = 256
     gamma: float = 0.99
-    lr: float = 3e-4
-    alpha_lr: float = 3e-4
-    tau: float = 0.005  # polyak target update rate
+    lr: float = 1e-3
+    alpha_lr: float = 1e-3
+    tau: float = 0.01  # polyak target update rate
     buffer_size: int = 100_000
     learning_starts: int = 1_000
-    train_batches: int = 64  # minibatch updates per iteration
-    batch_size: int = 256
+    # ~1 gradient step per sampled env step (num_workers * rollout_len /
+    # train_batches ≈ 2) — the standard SAC update-to-data ratio; at 0.1
+    # the policy visibly stalls
+    train_batches: int = 256  # minibatch updates per iteration
+    batch_size: int = 128
     target_entropy: Optional[float] = None  # default: -act_dim
     hidden: tuple = (128, 128)
     seed: int = 0

@@ -116,8 +116,7 @@ def test_sac_learns_point_goal(rt_rl):
 
     algo = SACConfig(
         env="PointGoal2D-v0", num_workers=2, rollout_len=256,
-        learning_starts=512, train_batches=48, batch_size=128,
-        hidden=(64, 64), seed=0,
+        learning_starts=512, hidden=(64, 64), seed=0,
     ).build()
     try:
         best = -1e9
