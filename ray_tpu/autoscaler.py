@@ -276,10 +276,15 @@ class TpuSliceAutoscaler:
         now = time.monotonic()
         live_keys = set()
         for handle in list(self.provider.non_terminated_slices()):
-            key = frozenset(self.provider.node_ids_of(handle))
+            node_ids = self.provider.node_ids_of(handle)
+            if not node_ids:
+                # still provisioning (async cloud grant): hosts have not
+                # joined yet — never idle-reap a slice we are waiting on
+                continue
+            key = frozenset(node_ids)
             live_keys.add(key)
             all_idle = True
-            for nid in self.provider.node_ids_of(handle):
+            for nid in node_ids:
                 view = views.get(nid.hex())
                 if view is None or view.get("demand") or (
                     view.get("available") != view.get("total")
