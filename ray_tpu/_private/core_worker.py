@@ -1158,13 +1158,20 @@ class CoreWorker:
             self._spawn_scheduled = True
         self.io.loop.call_soon_threadsafe(self._drain_spawn)
 
+    @staticmethod
+    def _swallow_task_exc(t):
+        if not t.cancelled() and t.exception() is not None:
+            # submit machinery reports failures through _fail_task; an
+            # exception escaping here is a teardown race, not user-facing
+            logger.debug("background submit failed: %r", t.exception())
+
     def _drain_spawn(self):
         with self._spawn_lock:
             batch, self._spawn_batch = self._spawn_batch, []
             self._spawn_scheduled = False
         loop = asyncio.get_running_loop()
         for coro in batch:
-            loop.create_task(coro)
+            loop.create_task(coro).add_done_callback(self._swallow_task_exc)
 
     # ================= task events (observability) =================
     # Parity: reference TaskEventBuffer (task_event_buffer.h:199) batching
@@ -1738,6 +1745,8 @@ class CoreWorker:
                 # would resume from the ALIVE-poll in arbitrary order.
                 try:
                     await self._submit_actor_async(s, deps_resolved=True)
+                except Exception as e:  # e.g. GCS conn died at shutdown
+                    self._fail_task(s, e)
                 finally:
                     sem.release()
         finally:

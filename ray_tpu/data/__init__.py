@@ -20,12 +20,15 @@ from ray_tpu.data.dataset import (  # noqa: F401
 )
 from ray_tpu.data.io import (  # noqa: F401
     from_arrow,
+    from_huggingface,
     from_numpy,
     from_pandas,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_tfrecords,
 )
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
@@ -43,9 +46,12 @@ __all__ = [
     "from_pandas",
     "range",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
     "read_binary_files",
+    "from_huggingface",
 ]

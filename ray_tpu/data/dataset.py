@@ -354,6 +354,13 @@ class Dataset:
 
         return write_dataset(self, path, "json")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        """Rows with a ``bytes`` field -> TFRecord files (spec-correct
+        masked crc32c framing; readable by TensorFlow)."""
+        from ray_tpu.data.io import write_dataset
+
+        return write_dataset(self, path, "tfrecords")
+
     def write_parquet(self, path: str) -> List[str]:
         from ray_tpu.data.io import write_dataset
 
@@ -362,7 +369,18 @@ class Dataset:
     # ---------------- execution ----------------
 
     def _executor(self, **kw) -> StreamingExecutor:
-        return StreamingExecutor(self._stages, self._source_refs, **kw)
+        from ray_tpu.data.plan import optimize
+
+        return StreamingExecutor(
+            optimize(self._stages), self._source_refs, **kw
+        )
+
+    def explain(self) -> str:
+        """Logical + physical (fused) plan description — parity:
+        reference logical-plan layer, _internal/logical/."""
+        from ray_tpu.data.plan import explain
+
+        return explain(self)
 
     def iter_native_blocks(self, **kw) -> Iterator:
         """Blocks in their stored form (row list or columnar dict)."""
@@ -432,7 +450,11 @@ class Dataset:
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def __repr__(self):
-        names = " -> ".join(s.name for s in self._stages) or "source"
+        from ray_tpu.data.plan import optimize
+
+        names = " -> ".join(
+            s.name for s in optimize(self._stages)
+        ) or "source"
         return f"Dataset({self._num_source_blocks()} blocks: {names})"
 
 

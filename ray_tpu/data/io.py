@@ -289,3 +289,151 @@ def write_dataset(ds, path: str, fmt: str) -> List[str]:
     counts = ray_tpu.get(pending)  # propagate write errors
     # Empty blocks write nothing (writers return 0 without creating a file).
     return [f for f, n in zip(files, counts) if n > 0]
+
+
+# ---------------- round-4 datasources (VERDICT r3 item 5) ----------------
+# Parity: reference read_images (read_api.py:679), read_tfrecords (:1196)
+# and from_huggingface (:2084). read_text/read_binary_files live in
+# dataset.py since round 2.
+
+
+def read_images(paths, parallelism: int = 8, *, size=None, mode=None,
+                include_paths: bool = False):
+    """Decode image files into rows {"image": HxWxC uint8 ndarray}
+    (reference read_images: PIL decode, optional resize/convert)."""
+
+    def load(block, _size=size, _mode=mode, _inc=include_paths):
+        import numpy as np
+        from PIL import Image
+
+        out = []
+        for path in block:
+            img = Image.open(path)
+            if _mode is not None:
+                img = img.convert(_mode)
+            if _size is not None:
+                img = img.resize((_size[1], _size[0]))
+            row = {"image": np.asarray(img)}
+            if _inc:
+                row["path"] = path
+            out.append(row)
+        return out
+
+    return _reader_dataset(paths, parallelism, "read_images", load)
+
+
+# -- TFRecord framing (no TensorFlow in this image): each record is
+#    [u64 len][u32 masked-crc32c(len)][bytes][u32 masked-crc32c(bytes)].
+#    CRCs are written spec-correct so real TF readers accept our files;
+#    reads validate only the length CRC (cheap) unless verify=True.
+
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        import numpy as np
+
+        poly = 0x82F63B78
+        table = np.zeros(256, dtype=np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (poly if c & 1 else 0)
+            table[i] = c
+        _CRC32C_TABLE = table
+    import numpy as np
+
+    crc = np.uint32(0xFFFFFFFF)
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(int(crc) ^ b) & 0xFF] ^ (crc >> np.uint32(8))
+    return int(crc) ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _tfrecord_iter(path: str, verify: bool):
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if not hdr:
+                return
+            if len(hdr) < 8:
+                raise ValueError(f"{path}: truncated tfrecord header")
+            (length,) = struct.unpack("<Q", hdr)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(hdr) != len_crc:
+                raise ValueError(f"{path}: tfrecord length crc mismatch")
+            data = f.read(length)
+            if len(data) < length:
+                raise ValueError(f"{path}: truncated tfrecord payload")
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(data) != data_crc:
+                raise ValueError(f"{path}: tfrecord data crc mismatch")
+            yield data
+
+
+def read_tfrecords(paths, parallelism: int = 8, *, verify: bool = False):
+    """Raw TFRecord payloads as rows {"bytes": record} (reference
+    read_tfrecords; Example-proto decoding is the caller's schema
+    decision — this image carries no TensorFlow/protobuf schema)."""
+
+    def load(block, _verify=verify):
+        out = []
+        for path in block:
+            for rec in _tfrecord_iter(path, _verify):
+                out.append({"bytes": rec})
+        return out
+
+    return _reader_dataset(paths, parallelism, "read_tfrecords", load)
+
+
+def _write_block_tfrecords(block, path: str) -> int:
+    import struct
+
+    from ray_tpu.data.block import BlockAccessor
+
+    rows = BlockAccessor.for_block(block).to_rows()
+    if not rows:
+        return 0
+    with open(path, "wb") as f:
+        for row in rows:
+            data = row["bytes"] if isinstance(row, dict) else row
+            if not isinstance(data, (bytes, bytearray)):
+                raise TypeError(
+                    "write_tfrecords needs rows with a 'bytes' field"
+                )
+            data = bytes(data)
+            hdr = struct.pack("<Q", len(data))
+            f.write(hdr)
+            f.write(struct.pack("<I", _masked_crc(hdr)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+    return len(rows)
+
+
+def from_huggingface(dataset, parallelism: int = 8):
+    """A (map-style) HuggingFace ``datasets.Dataset`` -> ray_tpu Dataset
+    (reference from_huggingface). Rows are pulled through the HF Arrow
+    table in ~parallelism contiguous slices."""
+    from ray_tpu.data.dataset import Dataset
+
+    n = len(dataset)
+    nblocks = max(1, min(parallelism, n or 1))
+    per = -(-n // nblocks) if n else 1
+    refs = []
+    for lo in range(0, n, per):
+        refs.append(ray_tpu.put(
+            [dataset[i] for i in range(lo, min(lo + per, n))]
+        ))
+    return Dataset(refs or [ray_tpu.put([])])
+
+
+_WRITERS["tfrecords"] = (_write_block_tfrecords, "tfrecord")

@@ -1,0 +1,161 @@
+"""Round-4 Data additions: logical-plan operator fusion + the
+images/tfrecords/huggingface datasources (VERDICT r3 item 5).
+
+Parity anchors: reference ``python/ray/data/_internal/logical/rules/
+operator_fusion.py``, ``read_api.py:679`` (read_images), ``:1196``
+(read_tfrecords), ``:2084`` (from_huggingface).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------- plan fusion ----
+def test_adjacent_maps_fuse_in_physical_plan(rt):
+    from ray_tpu.data.plan import FusedStage, optimize
+
+    ds = (
+        rd.range(10)
+        .map(lambda x: x + 1)
+        .filter(lambda x: x % 2 == 0)
+        .map_batches(lambda rows: rows, batch_format="rows")
+    )
+    phys = optimize(ds._stages)
+    # range-expand + map + filter + map_batches collapse into ONE stage
+    assert len(phys) == 1 and isinstance(phys[0], FusedStage)
+    assert "map" in phys[0].name and "filter" in phys[0].name
+    # both plans visible to users
+    text = ds.explain()
+    assert "Logical plan" in text and "Fused[" in text
+
+
+def test_fusion_breaks_at_exchange_and_actor_pool(rt):
+    from ray_tpu.data.plan import FusedStage, optimize
+
+    ds = (
+        rd.range(20)
+        .map(lambda x: x + 1)
+        .random_shuffle(seed=0)
+        .map(lambda x: x * 2)
+        .map(lambda x: x - 1)
+    )
+    phys = optimize(ds._stages)
+    # [Fused(range+map)] [Exchange] [Fused(map+map)]
+    assert len(phys) == 3
+    assert isinstance(phys[0], FusedStage)
+    assert phys[1].name == "random_shuffle"
+    assert isinstance(phys[2], FusedStage)
+
+    pool = rd.ActorPoolStrategy(size=1)
+    ds2 = (
+        rd.range(10)
+        .map(lambda x: x + 1)
+        .map_batches(lambda rows: rows, batch_format="rows", compute=pool)
+    )
+    phys2 = optimize(ds2._stages)
+    assert len(phys2) == 2  # actor-pool stage not fused into task stage
+
+
+def test_fused_pipeline_results_match_unfused(rt):
+    from ray_tpu.data import plan
+
+    ds = (
+        rd.from_items(list(range(50)), parallelism=4)
+        .map(lambda x: x + 1)
+        .filter(lambda x: x % 2 == 0)
+        .map(lambda x: x * 10)
+    )
+    fused = sorted(ds.take_all())
+    # force unfused execution for comparison
+    orig = plan.optimize
+    try:
+        plan.optimize = lambda stages: stages
+        unfused = sorted(ds.take_all())
+    finally:
+        plan.optimize = orig
+    assert fused == unfused == [i * 10 for i in range(2, 52, 2)]
+
+
+# ----------------------------------------------------------- datasources ----
+def test_read_images_roundtrip(rt, tmp_path):
+    from PIL import Image
+
+    for i in range(4):
+        arr = np.full((8, 6, 3), i * 10, dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+    ds = rd.read_images(str(tmp_path), parallelism=2, include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert len(rows) == 4
+    assert rows[0]["image"].shape == (8, 6, 3)
+    assert int(rows[2]["image"][0, 0, 0]) == 20
+    # resize + mode conversion
+    small = rd.read_images(
+        str(tmp_path), size=(4, 3), mode="L"
+    ).take_all()
+    assert small[0]["image"].shape == (4, 3)
+
+
+def test_tfrecords_roundtrip(rt, tmp_path):
+    payloads = [b"alpha", b"bravo" * 100, b"", b"delta"]
+    ds = rd.from_items([{"bytes": p} for p in payloads], parallelism=2)
+    files = ds.write_tfrecords(str(tmp_path / "out"))
+    assert files
+    back = rd.read_tfrecords(
+        [str(p) for p in sorted((tmp_path / "out").iterdir())],
+        verify=True,  # full masked-crc32c validation on read
+    ).take_all()
+    assert [r["bytes"] for r in back] == payloads
+
+
+def test_tfrecord_crc_is_spec_masked_crc32c():
+    """Golden value check so our files are readable by real TF readers:
+    crc32c("123456789") == 0xE3069283 (the canonical Castagnoli vector),
+    masking per the TFRecord spec."""
+    from ray_tpu.data.io import _crc32c, _masked_crc
+
+    assert _crc32c(b"123456789") == 0xE3069283
+    crc = 0xE3069283
+    expected_mask = (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert _masked_crc(b"123456789") == expected_mask
+
+
+def test_tfrecords_corruption_detected(rt, tmp_path):
+    ds = rd.from_items([{"bytes": b"payload-123"}], parallelism=1)
+    files = ds.write_tfrecords(str(tmp_path / "c"))
+    path = files[0]
+    raw = bytearray(open(path, "rb").read())
+    raw[-6] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(Exception):
+        rd.read_tfrecords([path], verify=True).take_all()
+    # verify=False skips crc validation (framing still parses)
+    out = rd.read_tfrecords([path], verify=False).take_all()
+    assert len(out) == 1
+
+
+def test_from_huggingface_shape(rt):
+    """Works with any map-style dataset (len + int indexing) — the HF
+    Dataset surface from_huggingface relies on."""
+
+    class FakeHF:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return {"text": f"t{i}", "label": i % 2}
+
+    ds = rd.from_huggingface(FakeHF(), parallelism=3)
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert rows[3] == {"text": "t3", "label": 1}
+    assert ds.num_blocks() >= 3
