@@ -125,6 +125,10 @@ _d("task_events_enabled", bool, True)
 _d("metrics_report_interval_ms", int, 2000)
 _d("object_spilling_enabled", bool, True)
 _d("object_spilling_threshold", float, 0.8)
+# external spill target: "" = session-local disk; file:///path, or a
+# bucket URI (gs://..., mock-bucket:///dir for cloud-free testing) —
+# reference external_storage.py smart_open cloud spilling
+_d("spill_storage_uri", str, "")
 _d("log_to_driver", bool, True)
 # "memory" | "file": file-backed GCS tables reload across GCS restarts
 # (reference Redis-backed GCS FT, redis_store_client.h:33)

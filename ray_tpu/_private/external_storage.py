@@ -1,0 +1,352 @@
+"""Pluggable external storage: spill targets + checkpoint sync backends.
+
+Parity: reference ``python/ray/_private/external_storage.py`` (the
+FileSystemStorage / ExternalStorageSmartOpenImpl split — spilling to
+local disk or a cloud bucket URI) and the storage half of
+``python/ray/tune/syncer.py`` (checkpoint upload/download).
+
+On a real TPU pod the host disk is small and ephemeral; the spill and
+checkpoint target is a bucket. No cloud credentials exist in CI, so the
+bucket path is an interface (:class:`BucketClient`) with a local fake
+(:class:`LocalBucketClient`) exercising the exact same code path; a GCS
+or S3 client implements the same four calls against the real service.
+
+URIs:
+  ``file:///abs/path`` or a bare path  -> :class:`FilesystemStorage`
+  ``gs://bucket/prefix`` ``s3://...``  -> :class:`BucketStorage`
+  ``mock-bucket:///abs/path``          -> BucketStorage over the fake
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class ExternalStorage:
+    """Byte-blob storage keyed by opaque string keys; returns stable URIs."""
+
+    def put(self, key: str, data) -> str:
+        """Store bytes under key; returns the blob's URI."""
+        raise NotImplementedError
+
+    def get(self, uri: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    # -- directory sync (checkpoint upload/download; reference syncer) --
+
+    def upload_dir(self, local_dir: str, prefix: str) -> str:
+        """Upload a directory tree under ``prefix``; returns its URI."""
+        base = local_dir.rstrip("/")
+        for root, _dirs, files in os.walk(base):
+            for fname in files:
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(path, base)
+                with open(path, "rb") as f:
+                    self.put(f"{prefix}/{rel}", f.read())
+        return self.uri_for(prefix)
+
+    def download_dir(self, prefix: str, local_dir: str) -> None:
+        os.makedirs(local_dir, exist_ok=True)
+        for rel in self.list_keys(prefix):
+            dst = os.path.join(local_dir, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "wb") as f:
+                f.write(self.get(self.uri_for(f"{prefix}/{rel}")))
+
+    def list_keys(self, prefix: str) -> List[str]:
+        """Keys under prefix, relative to it."""
+        raise NotImplementedError
+
+    def uri_for(self, key: str) -> str:
+        raise NotImplementedError
+
+
+class FilesystemStorage(ExternalStorage):
+    """Local/NFS directory backend (reference FileSystemStorage)."""
+
+    def __init__(self, base_dir: str):
+        self.base = base_dir.rstrip("/")
+        os.makedirs(self.base, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.normpath(os.path.join(self.base, key))
+        if not path.startswith(self.base):
+            raise ValueError(f"key escapes storage root: {key!r}")
+        return path
+
+    def put(self, key: str, data) -> str:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return "file://" + path
+
+    def get(self, uri: str) -> bytes:
+        with open(uri.removeprefix("file://"), "rb") as f:
+            return f.read()
+
+    def delete(self, uri: str) -> None:
+        try:
+            os.unlink(uri.removeprefix("file://"))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(uri.removeprefix("file://"))
+
+    def list_keys(self, prefix: str) -> List[str]:
+        base = self._path(prefix)
+        out = []
+        for root, _dirs, files in os.walk(base):
+            for fname in files:
+                out.append(
+                    os.path.relpath(os.path.join(root, fname), base)
+                )
+        return sorted(out)
+
+    def uri_for(self, key: str) -> str:
+        return "file://" + self._path(key)
+
+
+class BucketClient:
+    """The four blob calls a cloud SDK must provide (GCS: Client.bucket/
+    blob upload_from_string etc; S3: put_object/get_object/...)."""
+
+    def upload(self, name: str, data) -> None:
+        raise NotImplementedError
+
+    def download(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def delete_blob(self, name: str) -> None:
+        raise NotImplementedError
+
+    def list_blobs(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalBucketClient(BucketClient):
+    """Bucket fake over a local directory: flat blob-name keyspace with
+    '/' in names (exactly the cloud keyspace shape — no implicit
+    directories), so BucketStorage runs the same code against it as
+    against a real SDK."""
+
+    def __init__(self, root: str, recover_under: Optional[str] = None):
+        self.root = root
+        self._lock = threading.Lock()
+        self._blobs: Dict[str, str] = {}  # name -> file path
+        # recover pre-existing blobs (a restarted raylet's spill targets)
+        scan = recover_under or root
+        os.makedirs(scan, exist_ok=True)
+        for dirpath, _d, files in os.walk(scan):
+            for fname in files:
+                path = os.path.join(dirpath, fname)
+                name = os.path.relpath(path, root)
+                self._blobs[name] = path
+
+    def upload(self, name: str, data) -> None:
+        path = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        with self._lock:
+            self._blobs[name] = path
+
+    def download(self, name: str) -> bytes:
+        with self._lock:
+            path = self._blobs.get(name)
+        if path is None:
+            raise FileNotFoundError(f"no blob {name!r}")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def delete_blob(self, name: str) -> None:
+        with self._lock:
+            path = self._blobs.pop(name, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    def list_blobs(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                n for n in self._blobs if n.startswith(prefix)
+            )
+
+
+class BucketStorage(ExternalStorage):
+    """Cloud-bucket backend over a :class:`BucketClient`."""
+
+    def __init__(self, client: BucketClient, scheme: str, bucket: str,
+                 prefix: str = ""):
+        self.client = client
+        self.scheme = scheme
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _name(self, key: str) -> str:
+        key = key.strip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _parse(self, uri: str) -> str:
+        head = f"{self.scheme}://{self.bucket}/"
+        if not uri.startswith(head):
+            raise ValueError(f"{uri!r} is not under {head!r}")
+        return uri[len(head):]
+
+    def put(self, key: str, data) -> str:
+        name = self._name(key)
+        self.client.upload(name, data)
+        return f"{self.scheme}://{self.bucket}/{name}"
+
+    def get(self, uri: str) -> bytes:
+        return self.client.download(self._parse(uri))
+
+    def delete(self, uri: str) -> None:
+        self.client.delete_blob(self._parse(uri))
+
+    def exists(self, uri: str) -> bool:
+        try:
+            self.client.download(self._parse(uri))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def list_keys(self, prefix: str) -> List[str]:
+        base = self._name(prefix)
+        return [
+            n[len(base):].lstrip("/")
+            for n in self.client.list_blobs(base)
+        ]
+
+    def uri_for(self, key: str) -> str:
+        return f"{self.scheme}://{self.bucket}/{self._name(key)}"
+
+
+def _split_bucket_uri(uri: str) -> Tuple[str, str, str]:
+    scheme, rest = uri.split("://", 1)
+    bucket, _, prefix = rest.partition("/")
+    return scheme, bucket, prefix
+
+
+def storage_from_uri(uri: Optional[str]) -> Optional[ExternalStorage]:
+    """Resolve a spill/sync target URI to a backend. ``gs://`` / ``s3://``
+    require the matching cloud SDK (absent in CI — raise with a clear
+    message); ``mock-bucket://`` runs the bucket code path locally."""
+    if not uri:
+        return None
+    if uri.startswith("file://"):
+        return FilesystemStorage(uri.removeprefix("file://"))
+    if "://" not in uri:
+        return FilesystemStorage(uri)
+    scheme, bucket, prefix = _split_bucket_uri(uri)
+    if scheme == "mock-bucket":
+        # mock-bucket:///abs/dir — the whole path is the fake bucket's
+        # local root; blob names carry the path so URIs are stable across
+        # process restarts (a restarted raylet re-resolves the same URI)
+        base = "/" + prefix if not bucket else f"/{bucket}/{prefix}"
+        return BucketStorage(
+            LocalBucketClient("/", recover_under=base.rstrip("/")),
+            scheme, bucket, prefix,
+        )
+    if scheme == "gs":
+        try:
+            from google.cloud import storage as gcs  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "gs:// spill/sync needs google-cloud-storage (not in this "
+                "image); use file:// or mock-bucket:// locally"
+            ) from e
+
+        class _GcsClient(BucketClient):
+            def __init__(self, bucket_name):
+                self._bucket = gcs.Client().bucket(bucket_name)
+
+            def upload(self, name, data):
+                self._bucket.blob(name).upload_from_string(bytes(data))
+
+            def download(self, name):
+                import google.api_core.exceptions as gexc  # type: ignore
+
+                try:
+                    return self._bucket.blob(name).download_as_bytes()
+                except gexc.NotFound:
+                    raise FileNotFoundError(name) from None
+
+            def delete_blob(self, name):
+                try:
+                    self._bucket.blob(name).delete()
+                except Exception:
+                    pass
+
+            def list_blobs(self, prefix):
+                return sorted(
+                    b.name for b in self._bucket.list_blobs(prefix=prefix)
+                )
+
+        return BucketStorage(_GcsClient(bucket), scheme, bucket, prefix)
+    raise ValueError(f"unsupported storage scheme in {uri!r}")
+
+
+class DirSyncer:
+    """Incremental directory -> storage sync (reference tune/syncer.py
+    role): each ``sync()`` uploads only files whose (mtime, size) changed
+    since the last call. Deletions are not propagated (checkpoints are
+    append-mostly; the reference's default syncer behaves the same way)."""
+
+    def __init__(self, storage: ExternalStorage, local_dir: str,
+                 prefix: str):
+        self.storage = storage
+        self.local = local_dir.rstrip("/")
+        self.prefix = prefix.strip("/")
+        self._seen: Dict[str, Tuple[float, int]] = {}
+
+    def sync(self) -> int:
+        """Returns the number of files uploaded."""
+        uploaded = 0
+        for root, _dirs, files in os.walk(self.local):
+            for fname in files:
+                if fname.endswith(".tmp") or ".tmp." in fname:
+                    continue
+                path = os.path.join(root, fname)
+                try:
+                    st = os.stat(path)
+                except FileNotFoundError:
+                    continue
+                sig = (st.st_mtime, st.st_size)
+                rel = os.path.relpath(path, self.local)
+                if self._seen.get(rel) == sig:
+                    continue
+                with open(path, "rb") as f:
+                    self.storage.put(f"{self.prefix}/{rel}", f.read())
+                self._seen[rel] = sig
+                uploaded += 1
+        return uploaded
+
+
+def sync_dir(uri: str, local_dir: str, prefix: str) -> str:
+    """Upload ``local_dir`` under ``uri``/``prefix`` (tune syncer shape)."""
+    return storage_from_uri(uri).upload_dir(local_dir, prefix)
+
+
+def fetch_dir(uri: str, prefix: str, local_dir: str) -> None:
+    storage_from_uri(uri).download_dir(prefix, local_dir)
+
+
+def clear_dir_tree(path: str) -> None:
+    shutil.rmtree(path, ignore_errors=True)

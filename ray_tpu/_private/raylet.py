@@ -108,11 +108,22 @@ class Raylet:
         self.pg_bundle_total: Dict[bytes, Dict[int, Dict[str, float]]] = {}
         self.pg_bundle_avail: Dict[bytes, Dict[int, Dict[str, float]]] = {}
         # Object spilling (parity: local_object_manager.h:41 +
-        # external_storage.py FileSystemStorage): sealed LRU objects move to
-        # disk under memory pressure and restore on demand.
+        # external_storage.py): sealed LRU objects move to the configured
+        # external storage under memory pressure and restore on demand.
+        # Default target is session-local disk; on a real pod set
+        # spill_storage_uri to a bucket (host disk is small/ephemeral).
         self.spill_dir = os.path.join(session_dir, "spill",
                                       node_id.hex()[:12])
-        self.spilled: Dict[bytes, str] = {}  # oid -> file path
+        from ray_tpu._private.external_storage import (
+            FilesystemStorage,
+            storage_from_uri,
+        )
+
+        self.spill_storage = (
+            storage_from_uri(GLOBAL_CONFIG.spill_storage_uri)
+            or FilesystemStorage(self.spill_dir)
+        )
+        self.spilled: Dict[bytes, str] = {}  # oid -> storage URI
         self.spilled_bytes = 0
         self._spilling: Set[bytes] = set()  # oids with an in-flight spill
         self._ever_workers: Set[bytes] = set()  # for log tailing after death
@@ -1216,38 +1227,34 @@ class Raylet:
             if view is None:
                 return False
             loop = asyncio.get_running_loop()
+            nbytes = len(view)
             try:
-                os.makedirs(self.spill_dir, exist_ok=True)
-                path = os.path.join(self.spill_dir, oid.hex())
-                tmp = path + f".tmp.{os.urandom(4).hex()}"
-
-                def write():  # disk I/O off the event loop (heartbeats keep
-                    with open(tmp, "wb") as f:  # flowing during big spills)
-                        f.write(view)
-                    os.replace(tmp, path)
-
-                await loop.run_in_executor(None, write)
+                # storage I/O off the event loop (heartbeats keep flowing
+                # during big spills)
+                uri = await loop.run_in_executor(
+                    None, self.spill_storage.put, oid.hex(), view
+                )
             finally:
                 view.release()
                 self.store.release(oid)
-            self.spilled[oid.binary()] = path
-            self.spilled_bytes += os.path.getsize(path)
+            self.spilled[oid.binary()] = uri
+            self.spilled_bytes += nbytes
             self.store.delete(oid)  # refcount-safe: deferred if pinned
-            logger.info("spilled %s (%d bytes on disk)", oid.hex()[:12],
-                        self.spilled_bytes)
+            logger.info("spilled %s -> %s (%d bytes external)",
+                        oid.hex()[:12], uri[:60], self.spilled_bytes)
             return True
         finally:
             self._spilling.discard(oid.binary())
 
     async def _restore_object(self, oid) -> bool:
         """Bring a spilled object back into the store (get-path demand)."""
-        path = self.spilled.get(oid.binary())
-        if path is None:
+        uri = self.spilled.get(oid.binary())
+        if uri is None:
             return False
         loop = asyncio.get_running_loop()
         try:
             data = await loop.run_in_executor(
-                None, lambda: open(path, "rb").read()
+                None, self.spill_storage.get, uri
             )
         except FileNotFoundError:
             self.spilled.pop(oid.binary(), None)
@@ -1262,7 +1269,7 @@ class Raylet:
         self.spilled.pop(oid.binary(), None)
         self.spilled_bytes = max(0, self.spilled_bytes - len(data))
         try:
-            os.unlink(path)
+            self.spill_storage.delete(uri)
         except OSError:
             pass
         return True
@@ -1300,12 +1307,10 @@ class Raylet:
             self.store.delete(ObjectID(oid_bytes))
         except Exception:
             pass
-        path = self.spilled.pop(oid_bytes, None)
-        if path is not None:
+        uri = self.spilled.pop(oid_bytes, None)
+        if uri is not None:
             try:
-                size = os.path.getsize(path)
-                os.unlink(path)
-                self.spilled_bytes = max(0, self.spilled_bytes - size)
+                self.spill_storage.delete(uri)
             except OSError:
                 pass
         return True

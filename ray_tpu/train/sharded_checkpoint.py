@@ -329,3 +329,31 @@ def load_sharded(path: str, *, like=None, shardings=None):
             jax.make_array_from_callback(shape, sharding, cb)
         )
     return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+def upload_sharded_checkpoint(path: str, uri: str, *, step: int = 0) -> str:
+    """Push a committed sharded checkpoint to external storage (reference
+    tune/syncer.py upload path; on a pod the bucket is the durable copy —
+    host disks die with the slice). Call from ONE process after
+    ``save_sharded(..., wait=True)``; returns the remote URI."""
+    from ray_tpu._private.external_storage import storage_from_uri
+
+    if not is_committed(path, step):
+        raise RuntimeError(
+            f"checkpoint at {path} step {step} is not committed"
+        )
+    storage = storage_from_uri(uri)
+    name = os.path.basename(path.rstrip(os.sep))
+    return storage.upload_dir(path, name)
+
+
+def download_sharded_checkpoint(uri: str, path: str) -> str:
+    """Fetch a sharded checkpoint from external storage into ``path`` for
+    ``load_sharded`` (any mesh shape — cross-shape restore is the
+    loader's job)."""
+    from ray_tpu._private.external_storage import storage_from_uri
+
+    storage = storage_from_uri(uri.rsplit("/", 1)[0])
+    name = uri.rstrip("/").rsplit("/", 1)[1]
+    storage.download_dir(name, path)
+    return path

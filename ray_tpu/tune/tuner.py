@@ -133,6 +133,7 @@ class Tuner:
         resources_per_trial: Optional[Dict[str, float]] = None,
         storage_path: Optional[str] = None,
         name: str = "tune_experiment",
+        sync_uri: Optional[str] = None,
     ):
         self.trainable = trainable
         self.param_space = param_space or {}
@@ -142,6 +143,21 @@ class Tuner:
             os.path.join(storage_path, name) if storage_path else None
         )
         self._restored_state: Optional[Dict] = None
+        # cloud checkpoint sync (reference tune/syncer.py): every state
+        # snapshot incrementally uploads the experiment dir to the bucket
+        self._sync_uri = sync_uri
+        self._syncer = None
+        self._exp_name = name
+        if sync_uri is not None and self._exp_dir is not None:
+            from ray_tpu._private.external_storage import (
+                DirSyncer,
+                storage_from_uri,
+            )
+
+            os.makedirs(self._exp_dir, exist_ok=True)
+            self._syncer = DirSyncer(
+                storage_from_uri(sync_uri), self._exp_dir, name
+            )
 
     # -- experiment-level durability (parity: reference Tuner.restore,
     # tune/impl/tuner_internal.py:56 + experiment checkpointing) --
@@ -156,9 +172,26 @@ class Tuner:
         ``.fit()`` resumes unfinished trials from their last checkpoints
         with the searcher/scheduler state (PBT population, ASHA rungs,
         TPE observations) intact. Orphaned trial actors from the dead
-        driver are reaped on resume."""
+        driver are reaped on resume.
+
+        ``path`` may be a storage URI (``mock-bucket://...``, ``gs://``):
+        the synced experiment is downloaded to a fresh local dir first —
+        the lost-head-node recovery path (reference Tuner.restore from
+        cloud upload_dir)."""
         import cloudpickle
 
+        if "://" in path:
+            import tempfile
+
+            from ray_tpu._private.external_storage import storage_from_uri
+
+            storage = storage_from_uri(path.rsplit("/", 1)[0])
+            exp_name = path.rstrip("/").rsplit("/", 1)[1]
+            local = os.path.join(
+                tempfile.mkdtemp(prefix="tune_restore_"), exp_name
+            )
+            storage.download_dir(exp_name, local)
+            path = local
         path = path.rstrip(os.sep)
         with open(os.path.join(path, cls.META_FILE), "rb") as f:
             meta = cloudpickle.load(f)
@@ -212,6 +245,11 @@ class Tuner:
             },
             self.STATE_FILE,
         )
+        if self._syncer is not None:
+            try:
+                self._syncer.sync()
+            except Exception:
+                pass  # best-effort (reference syncer behavior)
 
     # -- controller --
 

@@ -1,0 +1,181 @@
+"""Pluggable external storage (VERDICT r3 item 9): filesystem + bucket
+backends, spill-through-bucket e2e, tune checkpoint sync, sharded
+checkpoint upload/download.
+
+Parity anchors: reference ``python/ray/_private/external_storage.py``
+(FileSystemStorage / smart_open cloud spilling) and
+``python/ray/tune/syncer.py``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.external_storage import (
+    BucketStorage,
+    DirSyncer,
+    FilesystemStorage,
+    LocalBucketClient,
+    storage_from_uri,
+)
+
+
+# ------------------------------------------------------------ backends ----
+@pytest.mark.parametrize("kind", ["fs", "bucket"])
+def test_put_get_delete_roundtrip(kind, tmp_path):
+    if kind == "fs":
+        st = FilesystemStorage(str(tmp_path / "store"))
+    else:
+        st = storage_from_uri(f"mock-bucket://{tmp_path}/bkt")
+    uri = st.put("objs/abc123", b"payload-bytes")
+    assert st.exists(uri)
+    assert st.get(uri) == b"payload-bytes"
+    st.delete(uri)
+    assert not st.exists(uri)
+
+
+def test_uri_stability_across_instances(tmp_path):
+    """A restarted process re-resolving the same config URI must still
+    find blobs written before the restart (spill durability)."""
+    uri_cfg = f"mock-bucket://{tmp_path}/bkt"
+    st1 = storage_from_uri(uri_cfg)
+    blob = st1.put("spill/deadbeef", b"spilled")
+    st2 = storage_from_uri(uri_cfg)  # fresh instance, same config
+    assert st2.get(blob) == b"spilled"
+
+
+def test_dir_sync_incremental(tmp_path):
+    src = tmp_path / "exp"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"one")
+    (src / "sub" / "b.txt").write_bytes(b"two")
+    st = storage_from_uri(f"mock-bucket://{tmp_path}/bkt")
+    syncer = DirSyncer(st, str(src), "exp")
+    assert syncer.sync() == 2
+    assert syncer.sync() == 0  # unchanged: nothing re-uploaded
+    (src / "a.txt").write_bytes(b"one-changed")
+    os.utime(src / "a.txt", (1e9, 2e9))  # force visible mtime change
+    assert syncer.sync() == 1
+    # download side sees the tree
+    dst = tmp_path / "restored"
+    st.download_dir("exp", str(dst))
+    assert (dst / "a.txt").read_bytes() == b"one-changed"
+    assert (dst / "sub" / "b.txt").read_bytes() == b"two"
+
+
+def test_unsupported_scheme_raises():
+    with pytest.raises(ValueError):
+        storage_from_uri("azure://x/y")
+
+
+def test_local_bucket_client_keyspace(tmp_path):
+    c = LocalBucketClient(str(tmp_path))
+    c.upload("a/b/c.bin", b"1")
+    c.upload("a/b2.bin", b"2")
+    assert c.list_blobs("a/") == ["a/b/c.bin", "a/b2.bin"]
+    assert c.download("a/b/c.bin") == b"1"
+    c.delete_blob("a/b/c.bin")
+    with pytest.raises(FileNotFoundError):
+        c.download("a/b/c.bin")
+
+
+# ------------------------------------------------------ spill e2e -----
+@pytest.mark.slow
+def test_spill_and_restore_through_bucket(tmp_path):
+    """Objects exceeding the store spill to the BUCKET backend and restore
+    on get — the real pod path where host disk is not the spill target."""
+    import ray_tpu
+
+    os.environ["RAYTPU_SPILL_STORAGE_URI"] = f"mock-bucket://{tmp_path}/bkt"
+    try:
+        ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+        try:
+            arrs = [
+                np.full(6 * 1024 * 1024, i, dtype=np.uint8)  # 6MB each
+                for i in range(16)  # 96MB total >> 64MB store
+            ]
+            refs = [ray_tpu.put(a) for a in arrs]
+            # bucket actually holds spilled blobs
+            bucket_files = []
+            for root, _d, files in os.walk(tmp_path / "bkt"):
+                bucket_files += files
+            assert bucket_files, "nothing was spilled to the bucket"
+            for i, ref in enumerate(refs):  # restores transparently
+                out = ray_tpu.get(ref, timeout=120)
+                assert out[0] == i and out[-1] == i
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAYTPU_SPILL_STORAGE_URI", None)
+
+
+# ----------------------------------------------- tune checkpoint sync -----
+@pytest.mark.slow
+def test_tuner_syncs_and_restores_from_bucket(tmp_path):
+    import ray_tpu
+    from ray_tpu import tune
+
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        def trainable(config):
+            from ray_tpu.train import session
+
+            for i in range(3):
+                session.report({"score": config["x"] * (i + 1)})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([1, 2])},
+            storage_path=str(tmp_path / "local"),
+            name="sync_exp",
+            sync_uri=f"mock-bucket://{tmp_path}/bkt",
+        )
+        grid = tuner.fit()
+        assert len(grid) == 2
+        # experiment state is in the bucket; restore WITHOUT the local dir
+        import shutil
+
+        shutil.rmtree(tmp_path / "local")
+        restored = tune.Tuner.restore(
+            f"mock-bucket://{tmp_path}/bkt/sync_exp", trainable
+        )
+        grid2 = restored.fit()  # everything finished: no new work
+        assert len(grid2) == 2
+        assert sorted(
+            r.metrics["score"] for r in grid2
+        ) == [3, 6]
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------- sharded checkpoint sync -----
+def test_sharded_checkpoint_roundtrip_through_bucket(tmp_path):
+    import jax
+
+    from ray_tpu.train.sharded_checkpoint import (
+        download_sharded_checkpoint,
+        load_sharded,
+        save_sharded,
+        upload_sharded_checkpoint,
+    )
+
+    state = {
+        "w": jax.numpy.arange(16.0).reshape(4, 4),
+        "step": 7,
+    }
+    local = str(tmp_path / "ckpt")
+    save_sharded(state, local, step=1, wait=True)
+    uri = upload_sharded_checkpoint(
+        local, f"mock-bucket://{tmp_path}/bkt", step=1
+    )
+    assert uri.startswith("mock-bucket://")
+    fetched = str(tmp_path / "fetched")
+    download_sharded_checkpoint(
+        f"mock-bucket://{tmp_path}/bkt/ckpt", fetched
+    )
+    restored = load_sharded(fetched)
+    np.testing.assert_allclose(
+        np.asarray(restored["['w']"]), np.arange(16.0).reshape(4, 4)
+    )
+    assert restored["['step']"] == 7
