@@ -1,0 +1,45 @@
+"""Perf-gate ratchet (VERDICT r3 item 10): floors rise to 0.98x the best
+checked-in BENCH value, so a 3% regression fails the bench run."""
+
+import importlib.util
+import os
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_floors_ratchet_to_best_prior(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(
+        bench, "_prior_bench_files",
+        lambda: [
+            {"metric": "train_step_mfu_400m", "value": 0.55,
+             "detail": {"micro": {"tasks_per_s": 1000.0}}},
+            {"metric": "train_step_mfu_400m", "value": 0.58,
+             "detail": {"micro": {"tasks_per_s": 3000.0,
+                                  "put_gbps": 2.0}}},
+        ],
+    )
+    floors = bench.ratchet_floors(
+        {"tasks_per_s": 150.0, "put_gbps": 0.4, "novel_metric": 5.0}
+    )
+    assert floors["tasks_per_s"] == 0.98 * 3000.0  # best prior wins
+    assert floors["put_gbps"] == 0.98 * 2.0
+    assert floors["novel_metric"] == 5.0  # no prior: static floor
+    # a deliberate 3% regression lands under the floor -> violation
+    assert 0.97 * 3000.0 < floors["tasks_per_s"]
+    assert bench.best_prior_mfu() == 0.58
+
+
+def test_cpu_bench_metric_excluded_from_mfu_ratchet(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(
+        bench, "_prior_bench_files",
+        lambda: [{"metric": "train_step_mfu_tiny_cpu", "value": 0.9}],
+    )
+    assert bench.best_prior_mfu() == 0.0  # CPU runs never set the bar
