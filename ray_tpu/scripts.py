@@ -45,6 +45,12 @@ def main(argv=None) -> int:
         jx = jsub.add_parser(name)
         jx.add_argument("job_id")
     jsub.add_parser("list")
+    sp = sub.add_parser("serve", help="declarative Serve ops")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy", help="deploy a YAML/JSON config file")
+    sd.add_argument("config", help="path to the serve config file")
+    ssub.add_parser("status", help="deployment/replica status")
+    ssub.add_parser("shutdown", help="tear down all deployments")
     args = p.parse_args(argv)
 
     if not args.address:
@@ -92,6 +98,18 @@ def main(argv=None) -> int:
             print("stopped")
         elif args.job_cmd == "list":
             print(json.dumps(client.list_jobs(), indent=2))
+    elif args.cmd == "serve":
+        from ray_tpu import serve
+        from ray_tpu.serve import schema as serve_schema
+
+        if args.serve_cmd == "deploy":
+            statuses = serve_schema.deploy_config_file(args.config)
+            print(json.dumps(statuses, indent=2))
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve.status(), indent=2, default=str))
+        elif args.serve_cmd == "shutdown":
+            serve.shutdown()
+            print("serve shut down")
     return 0
 
 

@@ -169,6 +169,18 @@ def delete(name: str) -> bool:
     return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
 
 
+def shutdown() -> None:
+    """Tear down every deployment (parity: serve.shutdown())."""
+    controller = _get_or_start_controller()
+    for name in list(status()):
+        try:
+            ray_tpu.get(
+                controller.delete_deployment.remote(name), timeout=60
+            )
+        except Exception:
+            pass
+
+
 def start_http_proxy(port: int = 0) -> str:
     """Start the HTTP ingress actor; returns its base URL."""
     global _proxy

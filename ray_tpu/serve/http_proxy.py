@@ -1,103 +1,35 @@
 """HTTP ingress proxy actor.
 
 Parity: reference ``python/ray/serve/_private/http_proxy.py:194`` (per-node
-HTTPProxy actor in front of the router). Stdlib ThreadingHTTPServer (no
-ASGI dependency in the wheel): ``POST /<deployment>`` with a JSON body
-routes through a DeploymentHandle and returns the JSON result.
+HTTPProxy actor in front of the router). Round 4: the ingress is the
+asyncio ASGI server in ``asgi.py`` — keep-alive, chunked streaming,
+connection caps — replacing the stdlib thread-per-connection server.
+``POST /<deployment>`` with a JSON body routes through a
+DeploymentHandle; ``POST /<deployment>/stream`` relays yields as chunked
+JSON lines.
 """
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
+
+from ray_tpu.serve.asgi import AsgiServer, ServeIngress
 
 
 class HTTPProxy:
-    """Actor body: runs the HTTP server on a thread; routes via handles."""
+    """Actor body: runs the ASGI ingress; routes via deployment handles."""
 
-    def __init__(self, controller, port: int = 0):
-        from ray_tpu.serve.handle import DeploymentHandle
-
+    def __init__(self, controller, port: int = 0,
+                 max_connections: int = 1024):
         self._controller = controller
-        self._handles: Dict[str, DeploymentHandle] = {}
-        proxy = self
-
-        class Handler(BaseHTTPRequestHandler):
-            # chunked transfer-encoding requires HTTP/1.1 on the status
-            # line — spec-compliant clients read an HTTP/1.0 body to EOF
-            # and would see the raw chunk framing
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):
-                pass
-
-            def do_POST(self):
-                parts = self.path.strip("/").split("/")
-                name = parts[0]
-                streaming = len(parts) > 1 and parts[1] == "stream"
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length)
-                    payload = json.loads(body) if body else None
-                    handle = proxy._handle_for(name)
-                    if streaming:
-                        self._stream_response(handle, payload)
-                        return
-                    result = handle.remote(payload).result(timeout=120)
-                    out = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                except KeyError:
-                    out = json.dumps(
-                        {"error": f"no deployment {name!r}"}
-                    ).encode()
-                    self.send_response(404)
-                except Exception as e:  # noqa: BLE001 — surfaced to client
-                    out = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(out)))
-                self.end_headers()
-                self.wfile.write(out)
-
-            def _stream_response(self, handle, payload):
-                """POST /<name>/stream — chunked JSON-lines response: each
-                chunk the deployment yields is written (and flushed) as it
-                arrives (parity: reference ASGI streaming responses,
-                http_proxy.py)."""
-                it = handle.stream(payload)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/jsonl")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
-                def chunk(data: bytes):
-                    self.wfile.write(f"{len(data):X}\r\n".encode())
-                    self.wfile.write(data + b"\r\n")
-                    self.wfile.flush()
-
-                try:
-                    for item in it:
-                        chunk(json.dumps({"chunk": item}).encode() + b"\n")
-                except Exception as e:  # noqa: BLE001 — surfaced in-band
-                    chunk(json.dumps({"error": str(e)}).encode() + b"\n")
-                finally:
-                    close = getattr(it, "close", None)
-                    if close:
-                        close()
-                self.wfile.write(b"0\r\n\r\n")
-                self.wfile.flush()
-
-            do_GET = do_POST
-
+        self._handles: Dict[str, object] = {}
+        self._app = ServeIngress(self._handle_for)
         # bind all interfaces: the proxy actor may live on any node and the
         # ingress must be reachable from outside the host
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
-        )
-        self._thread.start()
+        self._server = AsgiServer(
+            self._app, host="0.0.0.0", port=port,
+            max_connections=max_connections,
+        ).start()
 
     def _handle_for(self, name: str):
         from ray_tpu.serve.handle import DeploymentHandle
@@ -109,9 +41,14 @@ class HTTPProxy:
     def address(self):
         from ray_tpu._private.node import node_ip_address
 
-        _, port = self._server.server_address
-        return f"http://{node_ip_address()}:{port}"
+        return f"http://{node_ip_address()}:{self._server.port}"
+
+    def stats(self):
+        return {
+            "connections_now": self._server.connections_now,
+            "connections_peak": self._server.connections_peak,
+        }
 
     def shutdown(self):
-        self._server.shutdown()
+        self._server.stop()
         return True

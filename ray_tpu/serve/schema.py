@@ -1,0 +1,217 @@
+"""Declarative Serve config: schema + deploy-from-file.
+
+Parity: reference ``python/ray/serve/schema.py`` (ServeDeploySchema /
+ServeApplicationSchema / DeploymentSchema) and the ``serve deploy`` CLI
+(``python/ray/serve/scripts.py``): a YAML/JSON document describes the
+applications; deploying it is idempotent reconciliation, so ops teams
+redeploy the file instead of editing Python.
+
+Document shape (YAML or JSON)::
+
+    applications:
+      - name: api            # route prefix = /<deployment name>s
+        import_path: my_pkg.module:app_builder   # Deployment|Application|callable
+        args: {...}          # kwargs for .bind() / the builder
+        deployments:         # optional per-deployment overrides
+          - name: Adder
+            num_replicas: 2
+            user_config: {...}
+    http:
+      port: 8080             # 0 = ephemeral
+      max_connections: 1024
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, List, Optional
+
+
+class SchemaError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class DeploymentSchema:
+    name: str
+    num_replicas: Optional[int] = None
+    user_config: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    batch_max_size: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DeploymentSchema":
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise SchemaError(f"deployment: unknown keys {sorted(unknown)}")
+        if "name" not in d:
+            raise SchemaError("deployment: 'name' is required")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ApplicationSchema:
+    name: str
+    import_path: str
+    args: Optional[Dict[str, Any]] = None
+    deployments: List[DeploymentSchema] = dataclasses.field(
+        default_factory=list
+    )
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ApplicationSchema":
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise SchemaError(
+                f"application: unknown keys {sorted(unknown)}"
+            )
+        for key in ("name", "import_path"):
+            if key not in d:
+                raise SchemaError(f"application: {key!r} is required")
+        if ":" not in d["import_path"]:
+            raise SchemaError(
+                "import_path must be 'module.path:attribute'"
+            )
+        deps = [
+            DeploymentSchema.from_dict(x)
+            for x in d.get("deployments") or []
+        ]
+        return cls(
+            name=d["name"], import_path=d["import_path"],
+            args=d.get("args"), deployments=deps,
+        )
+
+
+@dataclasses.dataclass
+class ServeDeploySchema:
+    applications: List[ApplicationSchema]
+    http: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ServeDeploySchema":
+        unknown = set(d) - {"applications", "http"}
+        if unknown:
+            raise SchemaError(f"config: unknown keys {sorted(unknown)}")
+        apps = d.get("applications")
+        if not apps:
+            raise SchemaError("config: 'applications' must be non-empty")
+        names = [a.get("name") for a in apps]
+        if len(set(names)) != len(names):
+            raise SchemaError("config: duplicate application names")
+        return cls(
+            applications=[ApplicationSchema.from_dict(a) for a in apps],
+            http=d.get("http") or {},
+        )
+
+
+def load_config(path: str) -> ServeDeploySchema:
+    """Parse a YAML or JSON config file into a validated schema."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        doc = yaml.safe_load(text)
+    if not isinstance(doc, dict):
+        raise SchemaError("config root must be a mapping")
+    return ServeDeploySchema.from_dict(doc)
+
+
+def _import_target(import_path: str):
+    mod_name, _, attr = import_path.partition(":")
+    mod = importlib.import_module(mod_name)
+    try:
+        target = getattr(mod, attr)
+    except AttributeError as e:
+        raise SchemaError(
+            f"{import_path!r}: module has no attribute {attr!r}"
+        ) from e
+    return target
+
+
+def build_application(app_schema: ApplicationSchema):
+    """Resolve import_path to an Application, applying overrides.
+
+    The target may be: a bound Application, a Deployment (bound with
+    ``args``), or a builder callable returning either.
+    """
+    from ray_tpu.serve import Application, Deployment
+
+    target = _import_target(app_schema.import_path)
+    args = app_schema.args or {}
+    overrides = {d.name: d for d in app_schema.deployments}
+
+    if isinstance(target, Deployment):
+        target = _apply_overrides(target, overrides.get(target.name))
+        return target.bind(**args)
+    if callable(target) and not isinstance(target, Application):
+        built = target(**args)
+    else:
+        built = target
+    if isinstance(built, Deployment):
+        built = _apply_overrides(built, overrides.get(built.name))
+        return built.bind()
+    if not isinstance(built, Application):
+        raise SchemaError(
+            f"{app_schema.import_path!r} resolved to "
+            f"{type(built).__name__}, expected Application/Deployment"
+        )
+    # override the app's deployments in place (bind() captured them)
+    _override_application(built, overrides)
+    return built
+
+
+def _apply_overrides(dep, schema: Optional[DeploymentSchema]):
+    if schema is None:
+        return dep
+    opts = {}
+    for key in ("num_replicas", "user_config", "autoscaling_config",
+                "batch_max_size"):
+        val = getattr(schema, key)
+        if val is not None:
+            opts[key] = val
+    return dep.options(**opts) if opts else dep
+
+
+def _override_application(app, overrides: Dict[str, DeploymentSchema]):
+    from ray_tpu.serve import Application
+
+    seen = set()
+
+    def walk(a):
+        if id(a) in seen or not isinstance(a, Application):
+            return
+        seen.add(id(a))
+        schema = overrides.get(a.deployment.name)
+        if schema is not None:
+            a.deployment = _apply_overrides(a.deployment, schema)
+        for arg in list(a.init_args) + list(a.init_kwargs.values()):
+            walk(arg)
+
+    walk(app)
+
+
+def deploy_config(schema: ServeDeploySchema) -> Dict[str, str]:
+    """Deploy every application in the schema; returns {app: status}
+    (plus the ingress URL under ``"__http__"`` when configured)."""
+    from ray_tpu import serve
+
+    out = {}
+    for app_schema in schema.applications:
+        app = build_application(app_schema)
+        serve.run(app, name=app_schema.name)
+        out[app_schema.name] = "DEPLOYED"
+    if schema.http:
+        out["__http__"] = serve.start_http_proxy(
+            port=int(schema.http.get("port", 0))
+        )
+    return out
+
+
+def deploy_config_file(path: str) -> Dict[str, str]:
+    return deploy_config(load_config(path))

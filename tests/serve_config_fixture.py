@@ -1,0 +1,14 @@
+"""Import target for the declarative-config deploy test (the config
+file's ``import_path`` must resolve to a module attribute, exactly like
+user code in production)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="ConfigAdder")
+class ConfigAdder:
+    def __call__(self, payload):
+        return payload["a"] + payload["b"]
+
+
+adder = ConfigAdder

@@ -1,0 +1,180 @@
+"""Round-4 Serve ops surface (VERDICT r3 item 8): asyncio ASGI ingress +
+declarative config schema + ``serve deploy`` CLI.
+
+Parity anchors: reference ``serve/_private/http_proxy.py:194`` (ASGI
+proxy), ``serve/schema.py``, ``serve/scripts.py serve deploy``.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt_serve():
+    ray_tpu.init(num_cpus=3, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- schema ----
+def test_schema_validation_errors():
+    from ray_tpu.serve.schema import SchemaError, ServeDeploySchema
+
+    with pytest.raises(SchemaError, match="non-empty"):
+        ServeDeploySchema.from_dict({"applications": []})
+    with pytest.raises(SchemaError, match="import_path"):
+        ServeDeploySchema.from_dict({"applications": [{"name": "a"}]})
+    with pytest.raises(SchemaError, match="module.path:attribute"):
+        ServeDeploySchema.from_dict(
+            {"applications": [{"name": "a", "import_path": "no_colon"}]}
+        )
+    with pytest.raises(SchemaError, match="unknown keys"):
+        ServeDeploySchema.from_dict(
+            {"applications": [
+                {"name": "a", "import_path": "m:x", "replicas": 2}
+            ]}
+        )
+    with pytest.raises(SchemaError, match="duplicate"):
+        ServeDeploySchema.from_dict(
+            {"applications": [
+                {"name": "a", "import_path": "m:x"},
+                {"name": "a", "import_path": "m:y"},
+            ]}
+        )
+
+
+def test_schema_yaml_and_json_loading(tmp_path):
+    from ray_tpu.serve.schema import load_config
+
+    ycfg = tmp_path / "c.yaml"
+    ycfg.write_text(
+        "applications:\n"
+        "  - name: app1\n"
+        "    import_path: some.mod:dep\n"
+        "    deployments:\n"
+        "      - name: Dep\n"
+        "        num_replicas: 3\n"
+        "http:\n  port: 0\n"
+    )
+    schema = load_config(str(ycfg))
+    assert schema.applications[0].deployments[0].num_replicas == 3
+    jcfg = tmp_path / "c.json"
+    jcfg.write_text(json.dumps(
+        {"applications": [{"name": "x", "import_path": "m:a"}]}
+    ))
+    assert load_config(str(jcfg)).applications[0].name == "x"
+
+
+def test_deploy_from_config_file_via_cli(rt_serve, tmp_path):
+    """The ops loop: write a config file naming an import path, run
+    ``serve deploy`` through the CLI entry point, hit the deployment."""
+    from ray_tpu.scripts import main
+
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: math\n"
+        "    import_path: tests.serve_config_fixture:adder\n"
+        "    deployments:\n"
+        "      - name: ConfigAdder\n"
+        "        num_replicas: 2\n"
+        "http: {port: 0}\n"
+    )
+    rc = main(["--address", "local", "serve", "deploy", str(cfg)])
+    assert rc == 0
+    st = serve.status()
+    assert "math" in st  # deployed under the application name
+    assert st["math"]["num_replicas"] == 2  # override applied
+    h = serve.get_deployment_handle("math")
+    assert h.remote({"a": 1, "b": 2}).result(timeout=60) == 3
+
+
+# ------------------------------------------------------------- ingress ----
+def test_asgi_keepalive_and_methods(rt_serve):
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"got": payload}
+
+    serve.run(Echo.bind())
+    base = serve.start_http_proxy()
+    # two requests over ONE keep-alive connection
+    import http.client
+
+    host = base.removeprefix("http://")
+    conn = http.client.HTTPConnection(host, timeout=60)
+    for i in range(2):
+        conn.request(
+            "POST", "/Echo", body=json.dumps({"i": i}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["result"]["got"]["i"] == i
+    conn.close()
+
+
+@pytest.mark.slow
+def test_streaming_under_100_concurrent_connections(rt_serve):
+    """The item-8 'done' bar: chunked streaming stays correct with 100
+    clients connected at once through the asyncio ingress."""
+
+    @serve.deployment(num_replicas=2)
+    class Streamer:
+        def __call__(self, payload):
+            for i in range(4):
+                yield {"req": payload["id"], "seq": i}
+
+    serve.run(Streamer.bind())
+    base = serve.start_http_proxy()
+    n_clients = 100
+    results = {}
+    errors = []
+    barrier = threading.Barrier(n_clients)
+
+    def client(cid):
+        import http.client
+
+        host = base.removeprefix("http://")
+        try:
+            conn = http.client.HTTPConnection(host, timeout=300)
+            body = json.dumps({"id": cid})
+            conn.request(
+                "POST", "/Streamer/stream", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            # every client has an OPEN connection with a request in
+            # flight before any reads a response
+            barrier.wait(timeout=120)
+            resp = conn.getresponse()
+            lines = [
+                json.loads(line)
+                for line in resp.read().decode().splitlines() if line
+            ]
+            conn.close()
+            assert [x["chunk"]["seq"] for x in lines] == [0, 1, 2, 3], lines
+            assert all(x["chunk"]["req"] == cid for x in lines)
+            results[cid] = True
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append((cid, repr(e)))
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors[:5]
+    assert len(results) == n_clients
+    # the server actually saw heavy concurrency
+    stats = ray_tpu.get(
+        serve._proxy.stats.remote(), timeout=30  # noqa: SLF001 — test probe
+    )
+    assert stats["connections_peak"] >= 50, stats
