@@ -33,10 +33,18 @@ from ray_tpu.ops.attention import NEG_INF, repeat_kv
 def prepare_for_inference(params, config: TransformerConfig):
     """Cast training params (fp32 master copy) to the compute dtype ONCE.
     Serving streams every weight per decode step — fp32 params double that
-    HBM traffic just to be cast in-kernel. Returns (params, config)."""
+    HBM traffic just to be cast in-kernel. Int8-quantized weights
+    (models/quant.py QTensor) pass through untouched: they dequantize
+    inside the consuming matmul. Returns (params, config)."""
     import dataclasses
 
-    cast = jax.tree.map(lambda x: x.astype(config.dtype), params)
+    from ray_tpu.models.quant import QTensor
+
+    cast = jax.tree.map(
+        lambda x: x if isinstance(x, QTensor) else x.astype(config.dtype),
+        params,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
     return cast, dataclasses.replace(config, param_dtype=config.dtype)
 
 

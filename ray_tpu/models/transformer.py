@@ -108,6 +108,19 @@ class TransformerConfig:
         )
 
     @staticmethod
+    def serve_7b() -> "TransformerConfig":
+        """7B-class serving config (BASELINE Serve north star is
+        Llama-2-7B): MHA 32x128 over d=4096, 32 layers, dense-gelu MLP at
+        d_ff=16384 — 6.7B params, the same count as Llama-2's swiglu at
+        11008. Served int8 (models/quant.py) on one chip: ~6.5GB weights
+        + bf16 KV."""
+        return TransformerConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            d_head=128, d_ff=16384, rotary_dim=128, max_seq_len=2048,
+            attn_impl="dense", remat=False,
+        )
+
+    @staticmethod
     def tiny(**kw) -> "TransformerConfig":
         base = dict(
             vocab_size=256, d_model=64, n_layers=2, n_heads=4,
