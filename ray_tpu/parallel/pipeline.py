@@ -61,6 +61,25 @@ def _param_specs(config: TransformerConfig, rules: AxisRules):
 _MANUAL_AXES = frozenset({"dp", "pp"})
 
 
+@jax.custom_vjp
+def _pmax_pp_sg(x):
+    """pmax over pp with a zero gradient: the logsumexp max-shift is
+    AD-inert, and lax.pmax has no differentiation rule at all (even a
+    stop_gradient around it still traces the primitive under vjp)."""
+    return lax.pmax(x, "pp")
+
+
+def _pmax_pp_sg_fwd(x):
+    return _pmax_pp_sg(x), None
+
+
+def _pmax_pp_sg_bwd(_res, g):
+    return (jnp.zeros_like(g),)
+
+
+_pmax_pp_sg.defvjp(_pmax_pp_sg_fwd, _pmax_pp_sg_bwd)
+
+
 def _restrict_spec(spec: P) -> P:
     """Keep only the MANUAL (dp/pp) axes of a PartitionSpec: the pipeline's
     shard_map is manual over (dp, pp) only, with tp left to GSPMD inside
@@ -77,9 +96,16 @@ def _restrict_spec(spec: P) -> P:
     return P(*(keep(e) for e in spec))
 
 
-def _pipeline_specs(config: TransformerConfig, rules: AxisRules):
+def _pipeline_specs(config: TransformerConfig, rules: AxisRules,
+                    vocab_parallel_head: bool = False):
     pspecs = jax.tree.map(_restrict_spec, _param_specs(config, rules),
                           is_leaf=lambda x: isinstance(x, P))
+    if vocab_parallel_head and "lm_head" in pspecs:
+        # vocab-parallel scoring (1F1B): each stage receives its OWN
+        # [d, V/pp] head block from the shard_map — a static local slice,
+        # 1/pp of the head memory per stage, and no dynamic vocab
+        # indexing for GSPMD to partition
+        pspecs["lm_head"] = P(None, "pp")
     data_spec = _restrict_spec(logical_to_spec(rules, ("batch", None)))
     return pspecs, data_spec
 
@@ -230,6 +256,16 @@ def pipeline_grads_1f1b(
       backward microbatch b = t - (2·(pp-1) - p)
     so the last stage backs up a microbatch immediately after forwarding
     it, and gradients ripple to stage 0 over pp-1 reverse hops.
+
+    Scoring is VOCAB-PARALLEL over the pp axis (round 4, the fix for the
+    masked-projection MFU tax DESIGN.md named): the last stage's output
+    for a microbatch is psum-broadcast to every stage, and each stage
+    projects only its V/pp vocab shard with a global-logsumexp
+    cross-entropy (Megatron-style parallel CE, here over the PIPELINE
+    axis). Per backward tick every stage does V/pp of the projection —
+    summed across stages that is exactly ONE projection's FLOPs, so the
+    uniform-SPMD program wastes nothing, for the price of two [mb,S,d]
+    psums per tick (<< the (pp-1)/pp · 2·T·d·V FLOPs it replaces).
     """
     c = config
     pp = mesh.shape["pp"]
@@ -238,12 +274,24 @@ def pipeline_grads_1f1b(
             raise ValueError(f"1F1B pipeline requires {ax}=1")
     if c.n_layers % pp:
         raise ValueError(f"pp={pp} must divide n_layers={c.n_layers}")
+    if c.vocab_size % pp:
+        raise ValueError(
+            f"pp={pp} must divide vocab_size={c.vocab_size} "
+            "(vocab-parallel scoring)"
+        )
     if c.attn_impl != "dense":
         raise ValueError("pipeline stages use dense attention (sp=1)")
     if c.moe_experts:
         raise ValueError("1F1B pipeline does not support MoE aux losses")
+    if c.tie_embeddings:
+        raise ValueError(
+            "1F1B vocab-parallel scoring needs an untied lm_head "
+            "(the embedding must stay whole for stage-0 ingestion); "
+            "use the GPipe schedule for tied-embedding models"
+        )
     M = num_microbatches
     W = 2 * pp  # ring slots: max input lifetime is 2*(pp-1) ticks
+    Vp = c.vocab_size // pp
 
     def body(params, tokens, targets, mask):
         p = lax.axis_index("pp")
@@ -260,18 +308,10 @@ def pipeline_grads_1f1b(
         msks = mask.reshape(M, mb, S)
         is_last = (p == pp - 1)
 
-        def stage_fn(prm, x_act, idx, score: bool):
+        def stage_fn(prm, x_act, idx):
             """One stage's forward for microbatch ``idx``: ingestion on
-            stage 0, the local layer shard, and (when ``score``) the
-            masked last-stage loss — all inside one function so vjp yields
-            embed/head grads on exactly the stages that own those terms.
-
-            Scoring runs ONLY inside the backward-tick vjp (each
-            microbatch is scored exactly once there); the forward tick
-            skips the vocab projection. Non-last stages still execute the
-            masked projection during backward ticks — per-device ``p``
-            rules out lax.cond (collective mismatch under tp-auto), the
-            known cost of uniform-SPMD stages."""
+            stage 0 + the local layer shard. No scoring here — the
+            projection lives in score_fn, vocab-sharded across stages."""
             tok = lax.dynamic_index_in_dim(toks, idx, 0, keepdims=False)
             embed = prm["embed"].astype(c.dtype)
             x_in = jnp.where(p == 0, embed[tok], x_act)
@@ -284,61 +324,96 @@ def pipeline_grads_1f1b(
 
             lyr = remat_wrap(lyr, c)
             x_out, _aux = lax.scan(lyr, x_in, prm["layers"])
-            if not score:
-                return x_out
-            xl = _rms_norm(x_out, prm["final_ln"]["scale"])
-            head = (
-                prm["embed"].T if c.tie_embeddings else prm["lm_head"]
-            ).astype(c.dtype)
-            logits = jnp.einsum("msd,dv->msv", xl, head).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
+            return x_out
+
+        def score_fn(prm, x_fin, idx):
+            """Vocab-parallel CE for microbatch ``idx`` on x_fin (the
+            last stage's output, replicated across pp): THIS stage's
+            [d, V/pp] head block (delivered pp-sharded by the shard_map —
+            no dynamic slicing) + psum-combined logsumexp/target pieces.
+            Returns the GLOBAL (replicated) loss sum + count."""
+            xl = _rms_norm(x_fin, prm["final_ln"]["scale"])
+            hs = prm["lm_head"].astype(c.dtype)  # [d, V/pp] local block
+            logits = jnp.einsum("msd,dv->msv", xl, hs).astype(jnp.float32)
+            gmax = _pmax_pp_sg(jnp.max(logits, axis=-1))  # [mb,S]
+            denom = lax.psum(
+                jnp.exp(logits - gmax[..., None]).sum(-1), "pp"
+            )
             tgt = lax.dynamic_index_in_dim(tgts, idx, 0, keepdims=False)
             mk = lax.dynamic_index_in_dim(msks, idx, 0, keepdims=False)
-            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            gate = jnp.where(is_last, 1.0, 0.0)
-            loss_sum = -(ll * mk).sum() * gate
-            cnt = mk.sum() * gate
-            return x_out, loss_sum, cnt
+            loc = tgt - p * Vp
+            inrange = (loc >= 0) & (loc < Vp)
+            pick = jnp.take_along_axis(
+                logits, jnp.clip(loc, 0, Vp - 1)[..., None], axis=-1
+            )[..., 0]
+            tgt_logit = lax.psum(jnp.where(inrange, pick, 0.0), "pp")
+            ll = tgt_logit - (gmax + jnp.log(denom))
+            return -(ll * mk).sum(), mk.sum()
 
         T = M + 2 * pp - 2
 
         def tick(carry, t):
             act_in, g_in, ring, grads, loss_sum, count = carry
-            # ---- forward slot (no scoring: see stage_fn docstring) ----
+            # ---- forward slot ----
             f = t - p
             f_act = (f >= 0) & (f < M)
             fidx = jnp.clip(f, 0, M - 1)
-            x_out = stage_fn(params, act_in, fidx, score=False)
+            x_out = stage_fn(params, act_in, fidx)
             slot = fidx % W
             cur = lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
             ring = lax.dynamic_update_index_in_dim(
                 ring, jnp.where(f_act, act_in, cur), slot, 0
             )
-            # ---- backward slot (vjp over a recomputed, SCORED stage) ----
+            # ---- score slot: SYNCHRONIZED across stages ----
+            # The cross-stage psums inside score_fn require every stage to
+            # be scoring the SAME microbatch, so scoring is its own slot
+            # (not part of the staggered backward): all stages score
+            # s = t-(pp-1), the microbatch whose final-stage output was
+            # just produced — which is also exactly the last stage's
+            # backward microbatch this tick, so dL/dx_final hands off to
+            # the backward slot below with no buffering.
+            s = t - (pp - 1)
+            s_act = (s >= 0) & (s < M)
+            sidx = jnp.clip(s, 0, M - 1)
+            xf = lax.all_gather(x_out, "pp")[pp - 1]
+            # seed 1/pp: psum's transpose SUMS the replicated cotangents
+            # across pp, so a unit seed on every stage would inflate the
+            # score grads by pp (verified against dense AD)
+            seed = jnp.where(s_act, 1.0 / pp, 0.0)
+            (lsum, cnt), score_vjp = jax.vjp(
+                lambda pr, xf_: score_fn(pr, xf_, sidx), params, xf
+            )
+            # the loss is replicated across pp: accumulate on ONE stage
+            gate_last = jnp.where(s_act & is_last, 1.0, 0.0)
+            loss_sum = loss_sum + lsum * gate_last
+            count = count + cnt * gate_last
+            gp_score, dxf_p = score_vjp(
+                (seed.astype(jnp.float32), jnp.zeros((), jnp.float32))
+            )
+            # total dL/dx_final combines every stage's shard path
+            dxf = lax.psum(dxf_p.astype(jnp.float32), "pp").astype(c.dtype)
+            # ---- backward slot ----
             bmb = t - (2 * (pp - 1) - p)
             b_act = (bmb >= 0) & (bmb < M)
             bidx = jnp.clip(bmb, 0, M - 1)
             rx = lax.dynamic_index_in_dim(
                 ring, bidx % W, 0, keepdims=False
             )
-            # cotangents: upstream activation grad for non-last stages
-            # (zeroed when inactive), loss seed 1.0 on the last stage
-            g_eff = jnp.where(b_act & ~is_last, 1.0, 0.0) * g_in
-            loss_bar = jnp.where(b_act & is_last, 1.0, 0.0)
-            (_, lsum, cnt), vjp_fn = jax.vjp(
-                lambda pr, xa: stage_fn(pr, xa, bidx, score=True),
-                params, rx,
+            # cotangent: dL/dx_final on the last stage (whose backward
+            # microbatch IS the score slot's), rippled grad elsewhere
+            _, stage_vjp = jax.vjp(
+                lambda pr, xa: stage_fn(pr, xa, bidx), params, rx
             )
-            # each microbatch is scored exactly once: at its backward tick
-            loss_sum = loss_sum + jnp.where(b_act, lsum, 0.0)
-            count = count + jnp.where(b_act, cnt, 0.0)
-            gp, gx = vjp_fn((
-                g_eff.astype(c.dtype),
-                loss_bar.astype(jnp.float32),
-                jnp.zeros((), jnp.float32),
-            ))
-            grads = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
-                                 grads, gp)
+            cot = jnp.where(b_act, 1.0, 0.0) * jnp.where(
+                is_last, dxf, g_in
+            )
+            gp_stage, gx = stage_vjp(cot.astype(c.dtype))
+            grads = jax.tree.map(
+                lambda a, g1, g2: a + g1.astype(a.dtype) + g2.astype(
+                    a.dtype
+                ),
+                grads, gp_stage, gp_score,
+            )
             # ---- rotate: activations forward, grads backward ----
             act_next = lax.ppermute(
                 x_out, "pp", [(i, (i + 1) % pp) for i in range(pp)]
@@ -347,7 +422,9 @@ def pipeline_grads_1f1b(
                 gx.astype(c.dtype), "pp",
                 [(i, (i - 1) % pp) for i in range(pp)],
             )
-            return (act_next, g_next, ring, grads, loss_sum, count), None
+            return (
+                act_next, g_next, ring, grads, loss_sum, count,
+            ), None
 
         init = (
             jnp.zeros((mb, S, d), c.dtype),
@@ -369,14 +446,18 @@ def pipeline_grads_1f1b(
         def finalize(path, g):
             g = g / n
             g = lax.psum(g, "dp")
-            if not (path and getattr(path[0], "key", None) == "layers"):
+            # layers AND the vocab-parallel head are pp-LOCAL shards
+            # (each stage owns its slice); everything else is replicated
+            # across pp and needs the pp-reduction
+            if not (path and getattr(path[0], "key", None) in
+                    ("layers", "lm_head")):
                 g = lax.psum(g, "pp")
             return g
 
         grads = jax.tree_util.tree_map_with_path(finalize, grads)
         return ce, grads
 
-    pspecs, data_spec = _pipeline_specs(c, rules)
+    pspecs, data_spec = _pipeline_specs(c, rules, vocab_parallel_head=True)
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(batch["tokens"].shape, jnp.float32)
