@@ -1,11 +1,15 @@
-"""Workflow: durable DAG execution with resume.
+"""Workflow: durable DAG execution with resume, events, and step options.
 
 Parity: reference ``python/ray/workflow/`` — ``WorkflowExecutor``
 (workflow_executor.py:32), step-result storage (workflow_storage.py),
-``workflow.run``/``resume``. Steps are ``.bind()`` DAG nodes (ray_tpu.dag);
-every step's result is persisted under the workflow's storage directory
-before its dependents run, so a crashed workflow resumes from the last
-completed step instead of recomputing.
+``workflow.run``/``resume``, event steps (``workflow.wait_for_event`` +
+``http_event_provider.py`` — here over the native ASGI server), and
+per-step ``max_retries``/``catch_exceptions`` options. Steps are
+``.bind()`` DAG nodes (ray_tpu.dag); every step's result is persisted
+under the workflow's storage directory before its dependents run, so a
+crashed workflow resumes from the last completed step instead of
+recomputing — including received events, which replay from storage
+rather than waiting again.
 """
 
 from __future__ import annotations
@@ -88,17 +92,200 @@ class _WorkflowRun:
         os.replace(tmp, path)
 
 
-def _execute_node(node: DAGNode, input_value, run: _WorkflowRun,
+# ---------------------------------------------------------------- events ----
+
+
+class EventProvider:
+    """Blocking event source for ``wait_for_event`` steps (reference
+    ``workflow/event_listener.py`` EventListener shape)."""
+
+    def poll(self, event_key: str, timeout: Optional[float]) -> Any:
+        raise NotImplementedError
+
+
+class FileEventProvider(EventProvider):
+    """Events delivered by :func:`deliver_event` (programmatic/testing
+    provider): the payload lands as a file the poller picks up —
+    durable hand-off even if the workflow driver restarts mid-wait."""
+
+    def __init__(self, events_dir: Optional[str] = None):
+        self.events_dir = events_dir or os.path.join(
+            _default_storage(), "_events"
+        )
+
+    def _path(self, event_key: str) -> str:
+        safe = hashlib.sha256(event_key.encode()).hexdigest()[:24]
+        return os.path.join(self.events_dir, safe + ".pkl")
+
+    def deliver(self, event_key: str, payload: Any) -> None:
+        os.makedirs(self.events_dir, exist_ok=True)
+        path = self._path(event_key)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)
+
+    def poll(self, event_key: str, timeout: Optional[float]) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        path = self._path(event_key)
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    payload = pickle.load(f)
+                os.unlink(path)
+                return payload
+            except FileNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no event {event_key!r} within {timeout}s"
+                    ) from None
+                time.sleep(0.05)
+
+
+def deliver_event(event_key: str, payload: Any = None,
+                  events_dir: Optional[str] = None) -> None:
+    """Deliver an event to any workflow waiting on ``event_key``."""
+    FileEventProvider(events_dir).deliver(event_key, payload)
+
+
+class HTTPEventProvider(EventProvider):
+    """Events arrive as ``POST /event/<event_key>`` with a JSON body
+    (reference ``workflow/http_event_provider.py`` — here served by the
+    native ASGI server from serve/asgi.py). ``address`` gives the base
+    URL external systems post to."""
+
+    def __init__(self, port: int = 0):
+        from ray_tpu.serve.asgi import AsgiServer
+
+        self._events: Dict[str, Any] = {}
+        self._lock = __import__("threading").Lock()
+
+        async def app(scope, receive, send):
+            from ray_tpu.serve.asgi import _json_response
+
+            parts = [p for p in scope["path"].split("/") if p]
+            if len(parts) != 2 or parts[0] != "event":
+                await _json_response(send, 404, {"error": "POST /event/<key>"})
+                return
+            msg = await receive()
+            body = msg.get("body", b"")
+            payload = json.loads(body) if body else None
+            with self._lock:
+                self._events[parts[1]] = payload
+            await _json_response(send, 200, {"accepted": parts[1]})
+
+        self._server = AsgiServer(app, port=port, max_connections=64).start()
+
+    @property
+    def address(self) -> str:
+        from ray_tpu._private.node import node_ip_address
+
+        return f"http://{node_ip_address()}:{self._server.port}"
+
+    def poll(self, event_key: str, timeout: Optional[float]) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if event_key in self._events:
+                    return self._events.pop(event_key)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no event {event_key!r}")
+            time.sleep(0.05)
+
+    def shutdown(self):
+        self._server.stop()
+
+
+class EventNode:
+    """A DAG leaf that resolves to an external event's payload. Durable:
+    once received, the payload persists as a step — resume replays it
+    instead of waiting again."""
+
+    def __init__(self, event_key: str, provider: Optional[EventProvider],
+                 timeout: Optional[float]):
+        self.event_key = event_key
+        self.provider = provider
+        self.timeout = timeout
+
+    def __getstate__(self):
+        # The DAG snapshot must not capture live providers (an
+        # HTTPEventProvider holds a server thread). Received events
+        # replay from step storage on resume; a resume that is still
+        # WAITING falls back to the FileEventProvider for the key.
+        return {"event_key": self.event_key, "provider": None,
+                "timeout": self.timeout}
+
+    def __setstate__(self, st):
+        self.__dict__.update(st)
+
+
+def wait_for_event(event_key: str, provider: Optional[EventProvider] = None,
+                   timeout: Optional[float] = None) -> EventNode:
+    """An event step usable as an argument to any ``.bind()`` node (or
+    run directly). Default provider: :class:`FileEventProvider` fed by
+    :func:`deliver_event`."""
+    return EventNode(event_key, provider, timeout)
+
+
+# ---------------------------------------------------------- step options ----
+
+
+def step_options(node: DAGNode, *, max_retries: int = 0,
+                 catch_exceptions: bool = False) -> DAGNode:
+    """Reference ``workflow.options`` semantics: retry a failing step
+    ``max_retries`` times; with ``catch_exceptions`` the step's value
+    becomes ``(result, None)`` / ``(None, exception)`` instead of
+    propagating — downstream steps decide."""
+    node._wf_max_retries = max_retries
+    node._wf_catch = catch_exceptions
+    return node
+
+
+def _run_step(node: DAGNode, resolved_args, resolved_kwargs):
+    retries = getattr(node, "_wf_max_retries", 0)
+    catch = getattr(node, "_wf_catch", False)
+    attempt = 0
+    while True:
+        try:
+            value = ray_tpu.get(
+                node._fn.remote(*resolved_args, **resolved_kwargs),
+                timeout=600,
+            )
+            return (value, None) if catch else value
+        except Exception as e:  # noqa: BLE001 — step failure policy
+            attempt += 1
+            if attempt <= retries:
+                continue
+            if catch:
+                return (None, e)
+            raise
+
+
+def _execute_node(node, input_value, run: _WorkflowRun,
                   memo: Dict[int, Any]) -> Any:
     """Post-order durable execution. Returns the node's VALUE."""
     if id(node) in memo:
         return memo[id(node)]
 
+    if isinstance(node, EventNode):
+        sid = "event_" + hashlib.sha256(
+            node.event_key.encode()
+        ).hexdigest()[:16]
+        memo[f"id:{id(node)}"] = sid
+        if run.has_step(sid):
+            value = run.load_step(sid)
+        else:
+            provider = node.provider or FileEventProvider()
+            value = provider.poll(node.event_key, node.timeout)
+            run.save_step(sid, value)
+        memo[id(node)] = value
+        return value
+
     child_ids: List[str] = []
     literals: List[str] = []
     resolved_args = []
     for a in node._args:
-        if isinstance(a, DAGNode):
+        if isinstance(a, (DAGNode, EventNode)):
             resolved_args.append(_execute_node(a, input_value, run, memo))
             child_ids.append(memo[f"id:{id(a)}"])
         elif isinstance(a, InputNode):
@@ -109,7 +296,7 @@ def _execute_node(node: DAGNode, input_value, run: _WorkflowRun,
             literals.append(repr(a))
     resolved_kwargs = {}
     for k, v in sorted(node._kwargs.items()):
-        if isinstance(v, DAGNode):
+        if isinstance(v, (DAGNode, EventNode)):
             resolved_kwargs[k] = _execute_node(v, input_value, run, memo)
             child_ids.append(f"{k}={memo[f'id:{id(v)}']}")
         elif isinstance(v, InputNode):
@@ -124,9 +311,7 @@ def _execute_node(node: DAGNode, input_value, run: _WorkflowRun,
     if run.has_step(sid):
         value = run.load_step(sid)
     else:
-        value = ray_tpu.get(
-            node._fn.remote(*resolved_args, **resolved_kwargs), timeout=600
-        )
+        value = _run_step(node, resolved_args, resolved_kwargs)
         run.save_step(sid, value)
     memo[id(node)] = value
     return value

@@ -1,0 +1,139 @@
+"""Workflow depth (VERDICT r3 'what's missing' #7): event steps with
+file/HTTP providers, durable event replay on resume, and per-step
+retry/catch options.
+
+Parity anchors: reference ``workflow/http_event_provider.py``,
+``workflow/event_listener.py``, ``workflow.options(max_retries,
+catch_exceptions)``.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def rt_wf():
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_event_step_via_file_provider(rt_wf, tmp_path):
+    provider = workflow.FileEventProvider(str(tmp_path / "events"))
+
+    @ray_tpu.remote
+    def combine(evt, base):
+        return f"{base}:{evt['order_id']}"
+
+    dag = combine.bind(
+        workflow.wait_for_event("order-placed", provider, timeout=30),
+        "processed",
+    )
+
+    def deliver_later():
+        time.sleep(0.5)
+        provider.deliver("order-placed", {"order_id": 41})
+
+    t = threading.Thread(target=deliver_later)
+    t.start()
+    out = workflow.run(dag, workflow_id="evt_wf",
+                       storage=str(tmp_path / "wf"))
+    t.join()
+    assert out == "processed:41"
+    # durable replay: resume does NOT wait for a second event
+    out2 = workflow.resume("evt_wf", storage=str(tmp_path / "wf"))
+    assert out2 == "processed:41"
+
+
+def test_event_step_via_http_provider(rt_wf, tmp_path):
+    provider = workflow.HTTPEventProvider()
+    try:
+        @ray_tpu.remote
+        def seal(evt):
+            return evt["approved"]
+
+        dag = seal.bind(
+            workflow.wait_for_event("approval", provider, timeout=30)
+        )
+
+        def post_later():
+            time.sleep(0.5)
+            req = urllib.request.Request(
+                provider.address + "/event/approval",
+                data=json.dumps({"approved": True}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=30).read()
+
+        t = threading.Thread(target=post_later)
+        t.start()
+        out = workflow.run(dag, workflow_id="http_evt",
+                           storage=str(tmp_path / "wf"))
+        t.join()
+        assert out is True
+    finally:
+        provider.shutdown()
+
+
+def test_event_timeout_raises(rt_wf, tmp_path):
+    provider = workflow.FileEventProvider(str(tmp_path / "events"))
+
+    @ray_tpu.remote
+    def use(evt):
+        return evt
+
+    dag = use.bind(workflow.wait_for_event("never", provider, timeout=0.3))
+    with pytest.raises(TimeoutError):
+        workflow.run(dag, workflow_id="to_wf", storage=str(tmp_path / "wf"))
+    assert workflow.get_status(
+        "to_wf", storage=str(tmp_path / "wf")
+    ) == workflow.FAILED
+
+
+def test_step_max_retries(rt_wf, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote
+    def flaky(path):
+        import os
+        n = int(open(path).read()) if os.path.exists(path) else 0
+        open(path, "w").write(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"attempt {n} fails")
+        return "recovered"
+
+    dag = workflow.step_options(
+        flaky.bind(str(marker)), max_retries=2
+    )
+    out = workflow.run(dag, workflow_id="retry_wf",
+                       storage=str(tmp_path / "wf"))
+    assert out == "recovered"
+    assert int(marker.read_text()) == 3  # 1 try + 2 retries
+
+
+def test_step_catch_exceptions(rt_wf, tmp_path):
+    @ray_tpu.remote
+    def broken():
+        raise ValueError("kaput")
+
+    @ray_tpu.remote
+    def handle(pair):
+        value, err = pair
+        return "fallback" if err is not None else value
+
+    dag = handle.bind(
+        workflow.step_options(broken.bind(), catch_exceptions=True)
+    )
+    out = workflow.run(dag, workflow_id="catch_wf",
+                       storage=str(tmp_path / "wf"))
+    assert out == "fallback"
+    assert workflow.get_status(
+        "catch_wf", storage=str(tmp_path / "wf")
+    ) == workflow.SUCCEEDED
