@@ -349,6 +349,13 @@ def main():
             serving = measure_continuous_serving()
         except Exception as e:
             serving = {"error": str(e)[:160]}
+        # release the serving section's device footprint (7B int8 weights
+        # + KV caches) before the micro/RL sections — leftover HBM and
+        # engine-drain residue measurably skews the RL learner's numbers
+        import gc
+
+        gc.collect()
+        time.sleep(3.0)
         metric = "train_step_mfu_400m"
     else:
         cfg = TransformerConfig.tiny()

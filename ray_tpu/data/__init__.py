@@ -28,6 +28,7 @@ from ray_tpu.data.io import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_tfrecords,
 )
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
@@ -50,6 +51,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
     "read_binary_files",

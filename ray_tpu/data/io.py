@@ -437,3 +437,47 @@ def from_huggingface(dataset, parallelism: int = 8):
 
 
 _WRITERS["tfrecords"] = (_write_block_tfrecords, "tfrecord")
+
+
+def read_sql(sql: str, connection_factory, parallelism: int = 8):
+    """DB-API query -> rows (reference read_sql, read_api.py:2022: a
+    query string + a zero-arg connection factory, executed inside tasks).
+    Parallelism comes from sharding the query by LIMIT/OFFSET windows
+    when it has no LIMIT already; otherwise one task runs it whole."""
+    import ray_tpu
+    from ray_tpu.data.dataset import Dataset
+    from ray_tpu.data.streaming import Stage
+
+    def run_query(block):
+        out = []
+        for query in block:
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(query)
+                cols = [d[0] for d in cur.description]
+                out.extend(dict(zip(cols, row)) for row in cur.fetchall())
+            finally:
+                conn.close()
+        return out
+
+    lowered = sql.lower()
+    if "limit" in lowered or "offset" in lowered:
+        shards = [sql]
+    else:
+        # probe the row count once to build balanced windows
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT COUNT(*) FROM ({sql})")
+            n = int(cur.fetchone()[0])
+        finally:
+            conn.close()
+        nshards = max(1, min(parallelism, n or 1))
+        per = -(-n // nshards) if n else 1
+        shards = [
+            f"{sql} LIMIT {per} OFFSET {off}"
+            for off in range(0, max(n, 1), per)
+        ]
+    refs = [ray_tpu.put([q]) for q in shards]
+    return Dataset(refs, [Stage("read_sql", run_query)])

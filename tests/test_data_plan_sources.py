@@ -159,3 +159,36 @@ def test_from_huggingface_shape(rt):
     assert len(rows) == 10
     assert rows[3] == {"text": "t3", "label": 1}
     assert ds.num_blocks() >= 3
+
+
+def test_read_sql_sqlite(rt, tmp_path):
+    """DB-API source (reference read_sql): sharded LIMIT/OFFSET windows
+    over a sqlite database, executed inside tasks."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany(
+        "INSERT INTO items VALUES (?, ?)",
+        [(i, f"n{i}") for i in range(57)],
+    )
+    conn.commit()
+    conn.close()
+
+    def factory(_db=db):
+        import sqlite3 as _s
+
+        return _s.connect(_db)
+
+    ds = rd.read_sql("SELECT id, name FROM items ORDER BY id", factory,
+                     parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 57
+    assert sorted(r["id"] for r in rows) == list(range(57))
+    assert rows[0].keys() == {"id", "name"}
+    # pre-limited queries run unsharded
+    one = rd.read_sql(
+        "SELECT id FROM items ORDER BY id LIMIT 5", factory
+    ).take_all()
+    assert [r["id"] for r in one] == [0, 1, 2, 3, 4]
