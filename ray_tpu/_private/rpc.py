@@ -132,6 +132,7 @@ class Connection:
         # method -> fn(conn, data): notifies dispatched INLINE in the read
         # loop (no handler task) — the data-plane reply hot path
         self.sync_notify: Dict[str, Callable] = {}
+        self._cork = bytearray()  # send_notify_corked accumulator
 
     def start(self):
         self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -229,6 +230,22 @@ class Connection:
         if self._closed or self.writer.is_closing():
             raise SendError(f"connection {self.name} closed")
         self.writer.write(len(body).to_bytes(4, "big") + body)
+
+    def send_notify_corked(self, method: str, data: Any):
+        """Like send_notify but frames accumulate in a cork buffer; the
+        caller flushes with :meth:`flush_cork` (one transport write —
+        and typically one syscall — per burst instead of per frame).
+        The caller MUST flush before any await that waits on the peer."""
+        body = msgpack.packb([_NOTIFY, None, method, data], use_bin_type=True)
+        if self._closed or self.writer.is_closing():
+            raise SendError(f"connection {self.name} closed")
+        self._cork += len(body).to_bytes(4, "big") + body
+
+    def flush_cork(self):
+        if self._cork:
+            buf, self._cork = self._cork, bytearray()
+            if not (self._closed or self.writer.is_closing()):
+                self.writer.write(bytes(buf))
 
     def add_close_callback(self, cb: Callable[["Connection"], None]):
         if self._closed:
