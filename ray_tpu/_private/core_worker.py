@@ -53,10 +53,10 @@ def _conduit_available() -> bool:
 
 
 def _spec_from_slim(wire: List) -> TaskSpec:
-    """Decode the slim actor-push wire form (see _push_actor_stream)."""
-    task_id, actor_id, method, args, num_returns, seq_no, owner, retries = (
-        wire
-    )
+    """Decode the slim actor-push wire form (see _push_actor_stream for
+    the positional order; tests/test_basic.py pins the roundtrip)."""
+    (task_id, actor_id, method, args, num_returns, seq_no, owner,
+     retries, trace_ctx) = wire
     return TaskSpec(
         task_id=bytes(task_id),
         function_id=b"",
@@ -69,6 +69,7 @@ def _spec_from_slim(wire: List) -> TaskSpec:
         actor_id=bytes(actor_id),
         method_name=method,
         seq_no=seq_no,
+        trace_ctx=trace_ctx,
     )
 
 
@@ -1031,6 +1032,11 @@ class CoreWorker:
                 0.25, max(0.0, deadline - time.monotonic())
             )
             wake.wait(budget)
+        if len(ready) > num_returns:
+            # contract parity: at MOST num_returns in the ready list, even
+            # when one scan finds more (extras stay waitable)
+            pending = ready[num_returns:] + pending
+            ready = ready[:num_returns]
         return ready, pending
 
     def _request_pull(self, ref: ObjectRef, requested: Dict, wake=None):
@@ -1742,14 +1748,21 @@ class CoreWorker:
         if aid in self._actor_pumping:
             return
         self._actor_pumping.add(aid)
+        corked = None  # conn holding corked pushes awaiting flush
+        ncork = 0
+
+        def uncork():
+            nonlocal corked, ncork
+            if corked is not None:
+                corked.flush_cork()
+                corked, ncork = None, 0
+
         try:
             sem = self._actor_windows.get(aid)
             if sem is None:
                 sem = self._actor_windows[aid] = asyncio.Semaphore(
                     max(1, GLOBAL_CONFIG.actor_pipeline_depth)
                 )
-            corked = None  # conn holding corked pushes awaiting flush
-            ncork = 0
             while q:
                 s = q.popleft()
                 if s.task_id in self._cancelled:
@@ -1758,22 +1771,20 @@ class CoreWorker:
                         f"actor task {s.name} was cancelled before execution"
                     ))
                     continue
-                if corked is not None and any(a[0] == "r" for a in s.args):
+                if any(a[0] == "r" for a in s.args):
                     # this call's ObjectRef args may be produced by the
                     # corked (unsent!) pushes — flush before waiting
-                    corked.flush_cork()
-                    corked, ncork = None, 0
+                    uncork()
                 try:
                     await self._resolve_dependencies(s)
                 except Exception as e:
                     self._fail_task(s, e)
                     continue
-                if corked is not None and sem.locked():
+                if sem.locked():
                     # about to wait on the peer for a window slot: the
                     # corked pushes must hit the wire first (the replies
                     # that release slots depend on them)
-                    corked.flush_cork()
-                    corked, ncork = None, 0
+                    uncork()
                 await sem.acquire()
                 # Streaming push (one CORKED notify frame per call — a
                 # burst goes out in one transport write): the slot is
@@ -1783,25 +1794,24 @@ class CoreWorker:
                     corked = conn
                     ncork += 1
                     if ncork >= 32 or not q:
-                        corked.flush_cork()
-                        corked, ncork = None, 0
+                        uncork()
                     continue
                 # Cold or failing path: await the full round trip INLINE.
                 # Serializing here is what keeps submission order when N
                 # calls race a pending actor — concurrent slow pushes
                 # would resume from the ALIVE-poll in arbitrary order.
-                if corked is not None:
-                    corked.flush_cork()
-                    corked, ncork = None, 0
+                uncork()
                 try:
                     await self._submit_actor_async(s, deps_resolved=True)
                 except Exception as e:  # e.g. GCS conn died at shutdown
                     self._fail_task(s, e)
                 finally:
                     sem.release()
-            if corked is not None:
-                corked.flush_cork()
         finally:
+            # in the finally: a cancelled/failing pump must still put its
+            # corked pushes on the wire — their callers' refs hang forever
+            # otherwise (the conn is healthy, so no close-path recovery)
+            uncork()
             self._actor_pumping.discard(aid)
 
     async def _actor_address(self, actor_id: bytes, wait_alive=True):
@@ -1961,12 +1971,14 @@ class CoreWorker:
             info["state"] = "running"
         reg["specs"][spec.task_id] = spec
         try:
-            # slim wire: actor pushes carry only the 8 live fields (the
-            # full dict form is 5x the bytes and 4x the decode time)
+            # slim wire: actor pushes carry only the 9 live fields (the
+            # full dict form is 5x the bytes and 4x the decode time);
+            # trace_ctx rides along (None unless tracing is enabled) so
+            # distributed traces don't gap on the warm fast path
             conn.send_notify_corked("push_task_c", [
                 spec.task_id, spec.actor_id, spec.method_name, spec.args,
                 spec.num_returns, spec.seq_no, spec.owner,
-                spec.max_retries,
+                spec.max_retries, spec.trace_ctx,
             ])
         except rpc.SendError:
             reg["specs"].pop(spec.task_id, None)

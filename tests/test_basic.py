@@ -415,3 +415,37 @@ def test_multiprocessing_pool_shim(rt):
 
     with Pool(processes=2, initializer=init_global, initargs=(7,)) as pool:
         assert pool.map(read_global, range(4)) == [7, 7, 7, 7]
+
+
+def test_slim_actor_wire_roundtrip():
+    """The slim push_task_c codec's positional fields must stay in
+    lockstep between sender (_push_actor_stream) and the two decoders —
+    a silent field mis-assignment would scramble every actor call."""
+    import msgpack
+
+    from ray_tpu._private.core_worker import _spec_from_slim
+    from ray_tpu._private.protocol import TaskSpec
+
+    spec = TaskSpec(
+        task_id=b"t" * 16, function_id=b"", name="inc",
+        args=[["v", b"payload"]], num_returns=2, resources={},
+        max_retries=3, owner=[b"w" * 16, "unix:/tmp/x.sock", b"n" * 16],
+        actor_id=b"a" * 16, method_name="inc", seq_no=41,
+        trace_ctx=["trace", "parent", "span"],
+    )
+    wire = [spec.task_id, spec.actor_id, spec.method_name, spec.args,
+            spec.num_returns, spec.seq_no, spec.owner, spec.max_retries,
+            spec.trace_ctx]
+    decoded = _spec_from_slim(
+        msgpack.unpackb(msgpack.packb(wire, use_bin_type=True), raw=False)
+    )
+    assert decoded.task_id == spec.task_id
+    assert decoded.actor_id == spec.actor_id
+    assert decoded.method_name == decoded.name == "inc"
+    assert decoded.args == [["v", b"payload"]]
+    assert decoded.num_returns == 2
+    assert decoded.seq_no == 41
+    assert decoded.max_retries == 3
+    assert decoded.owner == [b"w" * 16, "unix:/tmp/x.sock", b"n" * 16]
+    assert decoded.trace_ctx == ["trace", "parent", "span"]
+    assert decoded.return_ids()  # derived ids still work
