@@ -31,6 +31,10 @@ from ray_tpu.data.io import (  # noqa: F401
     read_sql,
     read_tfrecords,
 )
+from ray_tpu.data.webdataset import (  # noqa: F401
+    read_webdataset,
+    write_webdataset,
+)
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data.streaming import ActorPoolStrategy  # noqa: F401
@@ -54,6 +58,8 @@ __all__ = [
     "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
+    "write_webdataset",
     "read_binary_files",
     "from_huggingface",
 ]

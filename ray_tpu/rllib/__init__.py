@@ -18,9 +18,16 @@ from ray_tpu.rllib.multi_agent import (  # noqa: F401
     MultiAgentPPO,
     MultiAgentPPOConfig,
 )
+from ray_tpu.rllib.offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    collect_experience,
+    read_experience,
+    write_experience_json,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 
 __all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-           "SAC", "SACConfig", "MultiAgentEnv", "MultiAgentPPO",
+           "SAC", "SACConfig", "BC", "BCConfig", "MultiAgentEnv", "MultiAgentPPO",
            "MultiAgentPPOConfig", "LearnerGroup"]

@@ -1,0 +1,207 @@
+"""Offline RL: dataset-backed experience input + behavioral cloning.
+
+Parity: reference ``rllib/offline/`` — the JSON sample reader/writer
+(``offline/json_reader.py`` / ``json_writer.py``: episodes as JSONL rows
+of obs/action/reward batches) and the canonical offline algorithm family
+representative, behavioral cloning (``rllib/algorithms/bc/bc.py`` —
+supervised max-likelihood on logged actions; the simplest member of the
+MARWIL family the reference derives it from).  Input rides
+``ray_tpu.data`` (a Dataset of transition rows), so logged experience
+shares the streaming/shuffle machinery with every other ingest path.
+
+TPU shape (repo convention): the whole training iteration is one jitted
+``lax.scan`` over minibatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
+
+
+# ------------------------------------------------------------- IO layer ----
+
+def write_experience_json(rows: List[Dict[str, Any]], path: str) -> int:
+    """Log transitions as JSONL (reference json_writer shape): each row
+    has obs (list), action (int), reward (float), done (bool)."""
+    import json
+
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps({
+                "obs": np.asarray(row["obs"], np.float32).tolist(),
+                "action": int(row["action"]),
+                "reward": float(row.get("reward", 0.0)),
+                "done": bool(row.get("done", False)),
+            }) + "\n")
+    return len(rows)
+
+
+def read_experience(paths, parallelism: int = 8):
+    """JSONL experience file(s) -> ray_tpu.data Dataset of transitions."""
+    import ray_tpu.data as rd
+
+    return rd.read_json(paths, parallelism=parallelism)
+
+
+def collect_experience(env_name: str, policy_fn, n_steps: int,
+                       seed: int = 0) -> List[Dict[str, Any]]:
+    """Roll a policy (obs -> action int) to produce offline rows."""
+    from ray_tpu.rllib.envs import make_env
+
+    env = make_env(env_name)
+    obs, _ = env.reset(seed=seed)
+    out = []
+    for _ in range(n_steps):
+        action = int(policy_fn(np.asarray(obs, np.float32).reshape(-1)))
+        nxt, reward, terminated, truncated, _ = env.step(action)
+        out.append({
+            "obs": np.asarray(obs, np.float32).reshape(-1),
+            "action": action,
+            "reward": float(reward),
+            "done": bool(terminated or truncated),
+        })
+        obs = env.reset()[0] if (terminated or truncated) else nxt
+    env.close()
+    return out
+
+
+# ------------------------------------------------------------------ BC ----
+
+@dataclasses.dataclass
+class BCConfig:
+    """Behavioral cloning over an offline Dataset (reference
+    algorithms/bc)."""
+
+    obs_dim: int = 0          # 0: infer from the first row
+    num_actions: int = 0
+    lr: float = 1e-3
+    epochs_per_iter: int = 4
+    minibatch: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self, dataset) -> "BC":
+        return BC(self, dataset)
+
+
+class BC:
+    """``BCConfig(...).build(ds).train()`` — each iteration is
+    ``epochs_per_iter`` jitted passes of minibatch SGD over the logged
+    (obs, action) pairs; ``evaluate(env)`` rolls the cloned policy."""
+
+    def __init__(self, config: BCConfig, dataset):
+        import jax
+        import optax
+
+        rows = dataset.take_all()
+        if not rows:
+            raise ValueError("offline dataset is empty")
+        self.obs = np.stack([
+            np.asarray(r["obs"], np.float32) for r in rows
+        ])
+        self.actions = np.asarray([r["action"] for r in rows], np.int32)
+        obs_dim = config.obs_dim or self.obs.shape[1]
+        num_actions = config.num_actions or int(self.actions.max()) + 1
+        self.config = config
+        self.num_actions = num_actions
+        self.params = init_actor_critic(
+            jax.random.key(config.seed), obs_dim, num_actions, config.hidden
+        )
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(config.seed + 1)
+        self._update = jax.jit(self._make_update())
+        self._iter = 0
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        def loss_fn(params, mb):
+            logits, _ = apply_actor_critic(params, mb["obs"])
+            logp = jax.nn.log_softmax(logits)
+            ll = jnp.take_along_axis(
+                logp, mb["actions"][:, None], axis=-1
+            )[:, 0]
+            return -ll.mean()
+
+        def update(params, opt_state, batches):
+            def mb_step(carry, mb):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                upd, opt_state = self.opt.update(grads, opt_state)
+                params = optax.apply_updates(params, upd)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                mb_step, (params, opt_state), batches
+            )
+            return params, opt_state, losses.mean()
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        c = self.config
+        self._iter += 1
+        n = len(self.obs)
+        mb = min(c.minibatch, n)
+        nmb = max(1, n // mb)
+        obs_b, act_b = [], []
+        for _ in range(c.epochs_per_iter):
+            perm = self._np_perm(n)[: nmb * mb].reshape(nmb, mb)
+            obs_b.append(self.obs[perm])
+            act_b.append(self.actions[perm])
+        batches = {
+            "obs": jnp.asarray(np.concatenate(obs_b)),
+            "actions": jnp.asarray(np.concatenate(act_b)),
+        }
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, batches
+        )
+        return {
+            "training_iteration": self._iter,
+            "num_samples": n,
+            "info": {"bc_loss": float(loss)},
+        }
+
+    def _np_perm(self, n):
+        return self._rng.permutation(n)
+
+    def compute_action(self, obs) -> int:
+        import jax
+
+        logits, _ = jax.jit(apply_actor_critic)(
+            self.params, np.asarray(obs, np.float32).reshape(1, -1)
+        )
+        return int(np.argmax(np.asarray(logits[0])))
+
+    def evaluate(self, env_name: str, episodes: int = 5,
+                 seed: int = 0) -> float:
+        """Mean episode return of the cloned policy."""
+        from ray_tpu.rllib.envs import make_env
+
+        env = make_env(env_name)
+        total = 0.0
+        for ep in range(episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            done = False
+            while not done:
+                obs, r, term, trunc, _ = env.step(
+                    self.compute_action(obs)
+                )
+                total += float(r)
+                done = term or trunc
+        env.close()
+        return total / episodes
+
+    def stop(self):
+        pass
