@@ -1,0 +1,66 @@
+"""joblib backend over the cluster (``register_ray()``).
+
+Parity: reference ``python/ray/util/joblib/`` — a joblib
+``ParallelBackendBase`` whose pool is the cluster-backed
+``util.multiprocessing.Pool``, so scikit-learn's ``n_jobs=-1`` scales
+over every node instead of local cores::
+
+    import joblib
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        GridSearchCV(...).fit(X, y)
+"""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import (
+    AutoBatchingMixin,
+    ParallelBackendBase,
+    PoolManagerMixin,
+)
+
+
+class RayTpuBackend(PoolManagerMixin, AutoBatchingMixin,
+                    ParallelBackendBase):
+    """joblib batches dispatch through Pool.apply_async(callback=...);
+    each batch runs inside a pool actor on whatever node has capacity."""
+
+    supports_retrieve_callback = True
+    supports_return_generator = False
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 in Parallel has no meaning")
+        if n_jobs is None:
+            return 1
+        if n_jobs < 0:
+            # -1 = the whole cluster's CPUs (reference semantics)
+            import ray_tpu
+
+            total = sum(
+                (n.get("resources") or {}).get("CPU", 0)
+                for n in ray_tpu.nodes()
+            )
+            n_jobs = max(1, int(total) + 1 + n_jobs)
+        return n_jobs
+
+    def configure(self, n_jobs=1, parallel=None, prefer=None, require=None,
+                  **kwargs):
+        import ray_tpu
+        from ray_tpu.util.multiprocessing import Pool
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        n_jobs = self.effective_n_jobs(n_jobs)
+        self.parallel = parallel
+        self._pool = Pool(processes=n_jobs)
+        return n_jobs
+
+
+def register_ray():
+    """Make ``joblib.parallel_backend("ray_tpu")`` available."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -156,13 +156,32 @@ class Pool:
         return self.apply_async(fn, args, kwds).get(timeout=None)
 
     def apply_async(self, fn: Callable, args: Tuple = (),
-                    kwds=None) -> AsyncResult:
+                    kwds=None, callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None
+                    ) -> AsyncResult:
+        """stdlib-parity apply_async incl. completion callbacks (the
+        surface joblib's PoolManagerMixin drives — util/joblib.py)."""
         self._check()
         # round-robin: concurrent applies spread across the pool
         actor = self._actors[self._rr % self._size]
         self._rr += 1
-        return AsyncResult([actor.apply.remote(fn, args, kwds)],
-                           flatten=False, single=True)
+        res = AsyncResult([actor.apply.remote(fn, args, kwds)],
+                          flatten=False, single=True)
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def waiter():
+                try:
+                    value = res.get(timeout=None)
+                except BaseException as e:  # noqa: BLE001 — relayed to cb
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(value)
+
+            threading.Thread(target=waiter, daemon=True).start()
+        return res
 
     # -- lifecycle --
 

@@ -134,3 +134,28 @@ def test_bc_clones_expert_policy(rt, tmp_path):
             done = term or trunc
     rand_score /= 5
     assert score > rand_score + 1.0, (score, rand_score)
+
+
+# ---------------------------------------------------------------- joblib ----
+def test_joblib_backend_runs_parallel_and_raises(rt):
+    """util misc parity (reference util/joblib): sklearn-style
+    joblib.Parallel rides the cluster pool, including error delivery."""
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(20)
+        )
+    assert out == [i * i for i in range(20)]
+
+    def boom(i):
+        if i == 3:
+            raise ValueError("boom-3")
+        return i
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        with pytest.raises(ValueError, match="boom-3"):
+            joblib.Parallel()(joblib.delayed(boom)(i) for i in range(6))
