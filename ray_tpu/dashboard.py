@@ -70,7 +70,38 @@ def _api(path: str):
                    "values": {str(k): v for k, v in m["values"].items()}}
             for name, m in agg.items()
         }
+    if path.startswith("node/"):
+        # per-node detail (parity: the reference per-node agent view):
+        # live raylet node_stats — resources, demand, workers, object
+        # plane, spill state — straight from the node's raylet
+        return _node_detail(path[len("node/"):])
+    if path == "timeline":
+        from ray_tpu.util import state
+
+        return state.timeline(None)
     raise KeyError(path)
+
+
+def _node_detail(node_id_hex: str):
+    import ray_tpu
+    import ray_tpu._private.rpc as rpc_mod
+    from ray_tpu._private.worker import require_connected
+
+    gcs = require_connected().gcs
+    for n in gcs.call("get_all_nodes", None, timeout=10):
+        if bytes(n["node_id"]).hex().startswith(node_id_hex):
+            client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
+            try:
+                stats = client.call("node_stats", None, timeout=10)
+            finally:
+                client.close()
+            return {
+                "node_id": bytes(n["node_id"]).hex(),
+                "raylet_addr": n["raylet_addr"],
+                "alive": n.get("alive", True),
+                "stats": stats,
+            }
+    raise KeyError(f"node/{node_id_hex}")
 
 
 def _prometheus_text() -> str:

@@ -260,3 +260,39 @@ def test_tpu_slice_autoscaler_gang_places_pg():
         assert len(provider.non_terminated_slices()) == 0
     finally:
         c.shutdown()
+
+
+def test_dashboard_node_detail_and_timeline(rt_plat):
+    """Round-4 dashboard depth: per-node raylet stats + timeline routes
+    (parity: the reference's per-node agent view / ray timeline API)."""
+    import json as _json
+    import urllib.error
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(4)], timeout=60) == [
+        1, 2, 3, 4
+    ]
+    url = start_dashboard()
+    try:
+        nodes = _json.loads(urllib.request.urlopen(
+            url + "/api/nodes", timeout=30).read())
+        assert nodes
+        nid = nodes[0]["node_id"]
+        detail = _json.loads(urllib.request.urlopen(
+            url + f"/api/node/{nid}", timeout=30).read())
+        assert detail["node_id"].startswith(nid[:12])
+        assert "resources" in detail["stats"] or detail["stats"]
+        tl = _json.loads(urllib.request.urlopen(
+            url + "/api/timeline", timeout=30).read())
+        assert isinstance(tl, list)  # chrome-trace events for Perfetto
+        # unknown node -> 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(url + "/api/node/ffffffffffff",
+                                   timeout=30)
+    finally:
+        stop_dashboard()
