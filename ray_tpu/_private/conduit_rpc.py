@@ -92,6 +92,9 @@ class ConduitConnection:
         self._closed = False
         self._close_callbacks: List = []
         self.order_gate: Optional[OrderGate] = None  # lazily by fast path
+        # batched task_done completions (see task_done_fn)
+        self._done_lock = threading.Lock()
+        self._done_buf: List = []
 
     # ---- outbound (any thread) ----
     def send_frame(self, kind, seqno, method, data):
@@ -113,19 +116,46 @@ class ConduitConnection:
 
         return fn
 
-    def task_done_fn(self, task_id: bytes) -> Callable[[dict], None]:
-        """Completion callback for STREAMED pushes: a task_done notify
-        keyed by task id (the caller correlates via its in-flight map)."""
+    def task_done_fn(self, task_id: bytes,
+                     flush_hint: Optional[Callable[[], bool]] = None
+                     ) -> Callable[[dict], None]:
+        """Completion callback for STREAMED pushes: task_done notifies
+        keyed by task id (the caller correlates via its in-flight map).
+
+        Completions BATCH: they accumulate in a per-connection buffer and
+        flush as ONE ``task_done_batch`` frame when the buffer reaches 16
+        or ``flush_hint()`` says the executor has drained its queue (so a
+        lone call still replies immediately) — the caller then processes
+        the whole batch in one read-loop iteration."""
 
         def fn(reply):
             try:
-                self.send_frame(
-                    rpc._NOTIFY, None, "task_done", [task_id, reply]
-                )
+                with self._done_lock:
+                    self._done_buf.append([task_id, reply])
+                    if len(self._done_buf) < 16 and not (
+                        flush_hint is None or flush_hint()
+                    ):
+                        return
+                    batch, self._done_buf = self._done_buf, []
+                if batch:
+                    self.send_frame(
+                        rpc._NOTIFY, None, "task_done_batch", batch
+                    )
             except Exception:
                 pass
 
         return fn
+
+    def flush_task_done(self):
+        """Backstop flush (exec-loop idle tick): completions buffered
+        behind another caller's queued work must not stall."""
+        try:
+            with self._done_lock:
+                batch, self._done_buf = self._done_buf, []
+            if batch:
+                self.send_frame(rpc._NOTIFY, None, "task_done_batch", batch)
+        except Exception:
+            pass
 
     # ---- rpc.Connection surface ----
     async def call_async(self, method, data, timeout=None):

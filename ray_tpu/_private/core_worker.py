@@ -418,6 +418,8 @@ class CoreWorker:
         self._actor_windows: Dict[bytes, asyncio.Semaphore] = {}
         # streaming push bookkeeping: conn -> {"addr", "specs": {tid: spec}}
         self._inflight_by_conn: Dict[Any, Dict] = {}
+        # executor side: conduit conns with batched task_done buffers
+        self._done_conns: set = set()
         # cross-thread submit batching (one loop wakeup per burst)
         self._spawn_lock = threading.Lock()
         self._spawn_batch: List = []
@@ -1965,6 +1967,7 @@ class CoreWorker:
         if reg is None:
             reg = self._inflight_by_conn[conn] = {"addr": addr, "specs": {}}
             conn.sync_notify["task_done"] = self._on_task_done
+            conn.sync_notify["task_done_batch"] = self._on_task_done_batch
             conn.add_close_callback(self._on_actor_conn_close)
         info = self._pending_tasks.get(spec.task_id)
         if info is not None:
@@ -1989,6 +1992,13 @@ class CoreWorker:
         sem = self._actor_windows.get(actor_id)
         if sem is not None:
             sem.release()
+
+    def _on_task_done_batch(self, conn, batch):
+        """One frame, N completions — the worker batches task_done
+        while its exec queue stays busy (one read-loop iteration and one
+        unpack amortize across the batch)."""
+        for entry in batch:
+            self._on_task_done(conn, entry)
 
     def _on_task_done(self, conn, data):
         """Inline (read-loop) completion of a streamed actor call."""
@@ -2136,7 +2146,10 @@ class CoreWorker:
         except Exception:
             return False
         if streamed:
-            reply_fn = conn.task_done_fn(spec.task_id)
+            reply_fn = conn.task_done_fn(
+                spec.task_id, flush_hint=self._exec_queue.empty
+            )
+            self._done_conns.add(conn)  # backstop flush (exec idle tick)
         else:
             reply_fn = conn.reply_fn(seqno, method)
         need = self._push_needs_staging(spec)
@@ -2324,6 +2337,13 @@ class CoreWorker:
             try:
                 item = self._exec_queue.get(timeout=0.1)
             except queue_mod.Empty:
+                # idle tick: flush any batched task_done completions left
+                # buffered behind another caller's queued work
+                for conn in list(self._done_conns):
+                    if conn.closed:
+                        self._done_conns.discard(conn)
+                    else:
+                        conn.flush_task_done()
                 continue
             spec, reply_to = item  # reply_to is thread-safe
 
