@@ -449,3 +449,21 @@ def test_slim_actor_wire_roundtrip():
     assert decoded.owner == [b"w" * 16, "unix:/tmp/x.sock", b"n" * 16]
     assert decoded.trace_ctx == ["trace", "parent", "span"]
     assert decoded.return_ids()  # derived ids still work
+
+
+def test_wait_returns_at_most_num_returns(rt):
+    """Reference contract: len(ready) <= num_returns even when one scan
+    finds more already-finished refs (regression: r4 verify probe)."""
+
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(8)]
+    ray_tpu.get(list(refs), timeout=60)  # everything finished
+    done, pending = ray_tpu.wait(refs, num_returns=3, timeout=30)
+    assert len(done) == 3
+    assert len(pending) == 5
+    # the leftovers are still waitable
+    done2, pending2 = ray_tpu.wait(pending, num_returns=5, timeout=30)
+    assert len(done2) == 5 and not pending2
