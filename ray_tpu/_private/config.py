@@ -133,5 +133,11 @@ _d("log_to_driver", bool, True)
 # "memory" | "file": file-backed GCS tables reload across GCS restarts
 # (reference Redis-backed GCS FT, redis_store_client.h:33)
 _d("gcs_storage_backend", str, "memory")
+# file-backend durability policy: how often dirty tables snapshot, and
+# whether each snapshot fsyncs data + dirent (power-loss durability at
+# ~ms/write; default off — the file tier's threat model is GCS process
+# death, where the atomic rename alone suffices)
+_d("gcs_snapshot_interval_s", float, 0.5)
+_d("gcs_snapshot_fsync", bool, False)
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)
