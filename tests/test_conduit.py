@@ -165,3 +165,64 @@ def test_pipelined_throughput_smoke(engine, tmp_path):
     rps = n_target / (time.perf_counter() - t0)
     assert rps > 1000, f"conduit echo only {rps:.0f} req/s"
     engine.close(cid)
+
+
+def test_tcp_transport(engine, tmp_path):
+    """The cross-host path: conduit listens/connects over TCP (port 0
+    resolved to the kernel-assigned port) with the same frame protocol."""
+    addr = engine.listen("tcp:127.0.0.1:0", lambda cid: engine.register(
+        cid,
+        lambda c, p: engine.send(
+            c, msgpack.packb(
+                [1] + msgpack.unpackb(p, raw=False)[1:], use_bin_type=True
+            )
+        ),
+    ))
+    assert addr.startswith("tcp:127.0.0.1:")
+    port = int(addr.rsplit(":", 1)[1])
+    assert port > 0
+    cid = engine.connect(addr)
+    got = []
+    done = threading.Event()
+    engine.register(cid, lambda c, p: (
+        got.append(msgpack.unpackb(p, raw=False)), done.set()
+    ))
+    engine.send(cid, msgpack.packb([0, 9, "m", b"over-tcp"],
+                                   use_bin_type=True))
+    assert done.wait(10)
+    assert got[0][3] == b"over-tcp"
+    engine.close(cid)
+
+
+def test_asyncio_fallback_transport_serves_actors():
+    """RAYTPU_NATIVE_WIRE=0: workers fall back to the asyncio server and
+    the streamed actor protocol (push_task_c notify + task_done) still
+    works end to end — the mixed-cluster / no-compiler deployment."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["RAYTPU_NATIVE_WIRE"] = "0"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+@ray_tpu.remote
+class C:
+    def __init__(self): self.x = 0
+    def inc(self):
+        self.x += 1
+        return self.x
+
+a = C.remote()
+out = ray_tpu.get([a.inc.remote() for _ in range(200)], timeout=120)
+assert out == list(range(1, 201)), out[:10]
+ray_tpu.shutdown()
+print("FALLBACK_OK")
+"""
+    env = dict(os.environ, RAYTPU_NATIVE_WIRE="0", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert "FALLBACK_OK" in r.stdout, (r.stdout[-500:], r.stderr[-1500:])
