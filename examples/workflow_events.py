@@ -1,0 +1,64 @@
+"""Durable workflow with an external-event gate.
+
+    python examples/workflow_events.py
+
+An ETL-ish DAG: extract -> (wait for an approval event) -> transform ->
+load. Every step's result persists before dependents run; kill the
+process mid-run and re-run it — completed steps (including the received
+event) replay from storage instead of recomputing.
+"""
+
+import tempfile
+import threading
+import time
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    storage = tempfile.mkdtemp(prefix="wf_demo_")
+    provider = workflow.FileEventProvider(storage + "/_events")
+
+    @ray_tpu.remote
+    def extract():
+        print("extract: pulling 100 records")
+        return list(range(100))
+
+    @ray_tpu.remote
+    def transform(records, approval):
+        print(f"transform: approved by {approval['by']}")
+        return [r * 2 for r in records]
+
+    @ray_tpu.remote
+    def load(rows):
+        print(f"load: {len(rows)} rows, checksum {sum(rows)}")
+        return sum(rows)
+
+    dag = load.bind(
+        transform.bind(
+            workflow.step_options(extract.bind(), max_retries=2),
+            workflow.wait_for_event("approval", provider, timeout=60),
+        )
+    )
+
+    def approve():
+        time.sleep(1.0)
+        print("(external system delivers the approval event)")
+        provider.deliver("approval", {"by": "ops@example"})
+
+    threading.Thread(target=approve, daemon=True).start()
+    result = workflow.run(dag, workflow_id="etl_demo", storage=storage)
+    print("workflow result:", result)
+
+    # resume is a no-op replay: every step (and the event) is on disk
+    again = workflow.resume("etl_demo", storage=storage)
+    assert again == result
+    print("resume replayed from storage:",
+          workflow.get_status("etl_demo", storage=storage))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
