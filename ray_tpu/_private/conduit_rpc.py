@@ -45,6 +45,7 @@ class OrderGate:
     def __init__(self):
         self._lock = threading.Lock()
         self._q: collections.deque = collections.deque()
+        self._draining = False  # single-drainer flag (see _drain)
 
     def submit(self, run: Callable[[], None], ready: bool):
         ent = {"run": run, "ready": ready}
@@ -68,12 +69,28 @@ class OrderGate:
         self._drain()
 
     def _drain(self):
+        # Exactly one thread drains at a time: reaper (submit) and IO loop
+        # (mark_ready) may race here, and two concurrent drainers could pop
+        # consecutive entries and invoke run() out of pop order.  The flag
+        # is cleared under the same lock hold as the empty/not-ready check,
+        # so a concurrent mark_ready either lands before the check (drainer
+        # sees it) or acquires the lock after the clear (becomes drainer).
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
         while True:
             with self._lock:
                 if not self._q or not self._q[0]["ready"]:
+                    self._draining = False
                     return
                 ent = self._q.popleft()
-            ent["run"]()
+            try:
+                ent["run"]()
+            except BaseException:
+                with self._lock:
+                    self._draining = False
+                raise
 
 
 class ConduitConnection:

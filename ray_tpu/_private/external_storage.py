@@ -79,7 +79,9 @@ class FilesystemStorage(ExternalStorage):
 
     def _path(self, key: str) -> str:
         path = os.path.normpath(os.path.join(self.base, key))
-        if not path.startswith(self.base):
+        # separator-suffixed compare: a bare prefix check would admit
+        # sibling escapes like base="/x/store", key="../store2/k"
+        if path != self.base and not path.startswith(self.base + os.sep):
             raise ValueError(f"key escapes storage root: {key!r}")
         return path
 

@@ -123,7 +123,7 @@ class Raylet:
             storage_from_uri(GLOBAL_CONFIG.spill_storage_uri)
             or FilesystemStorage(self.spill_dir)
         )
-        self.spilled: Dict[bytes, str] = {}  # oid -> storage URI
+        self.spilled: Dict[bytes, tuple] = {}  # oid -> (storage URI, nbytes)
         self.spilled_bytes = 0
         self._spilling: Set[bytes] = set()  # oids with an in-flight spill
         self._ever_workers: Set[bytes] = set()  # for log tailing after death
@@ -1237,7 +1237,7 @@ class Raylet:
             finally:
                 view.release()
                 self.store.release(oid)
-            self.spilled[oid.binary()] = uri
+            self.spilled[oid.binary()] = (uri, nbytes)
             self.spilled_bytes += nbytes
             self.store.delete(oid)  # refcount-safe: deferred if pinned
             logger.info("spilled %s -> %s (%d bytes external)",
@@ -1248,16 +1248,19 @@ class Raylet:
 
     async def _restore_object(self, oid) -> bool:
         """Bring a spilled object back into the store (get-path demand)."""
-        uri = self.spilled.get(oid.binary())
-        if uri is None:
+        entry = self.spilled.get(oid.binary())
+        if entry is None:
             return False
+        uri, _ = entry
         loop = asyncio.get_running_loop()
         try:
             data = await loop.run_in_executor(
                 None, self.spill_storage.get, uri
             )
         except FileNotFoundError:
-            self.spilled.pop(oid.binary(), None)
+            gone = self.spilled.pop(oid.binary(), None)
+            if gone is not None:
+                self.spilled_bytes = max(0, self.spilled_bytes - gone[1])
             return False
         buf = await self._create_local_with_spill(oid, len(data))
         if buf is None:
@@ -1307,11 +1310,13 @@ class Raylet:
             self.store.delete(ObjectID(oid_bytes))
         except Exception:
             pass
-        uri = self.spilled.pop(oid_bytes, None)
-        if uri is not None:
+        entry = self.spilled.pop(oid_bytes, None)
+        if entry is not None:
+            uri, nbytes = entry
+            self.spilled_bytes = max(0, self.spilled_bytes - nbytes)
             try:
                 self.spill_storage.delete(uri)
-            except OSError:
+            except Exception:  # bucket backends raise beyond OSError
                 pass
         return True
 

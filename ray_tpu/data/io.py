@@ -442,8 +442,17 @@ _WRITERS["tfrecords"] = (_write_block_tfrecords, "tfrecord")
 def read_sql(sql: str, connection_factory, parallelism: int = 8):
     """DB-API query -> rows (reference read_sql, read_api.py:2022: a
     query string + a zero-arg connection factory, executed inside tasks).
-    Parallelism comes from sharding the query by LIMIT/OFFSET windows
-    when it has no LIMIT already; otherwise one task runs it whole."""
+    Parallelism comes from sharding the query by LIMIT/OFFSET windows —
+    but ONLY when the query carries a top-level ORDER BY, since SQL row
+    order is otherwise unspecified and parallel windows could duplicate
+    or drop rows.  Unordered queries (and queries with their own
+    LIMIT/OFFSET) run whole in a single task.
+
+    Caveat: window sharding assumes the ORDER BY is a stable TOTAL order
+    (unique key) over a snapshot-consistent table.  With duplicate sort
+    keys, some engines break ties differently per execution, and writes
+    between the COUNT probe and the shard queries shift windows — pass
+    ``parallelism=1`` for strict correctness in those situations."""
     import ray_tpu
     from ray_tpu.data.dataset import Dataset
     from ray_tpu.data.streaming import Stage
@@ -462,14 +471,21 @@ def read_sql(sql: str, connection_factory, parallelism: int = 8):
         return out
 
     lowered = sql.lower()
-    if "limit" in lowered or "offset" in lowered:
+    # Shard only when ORDER BY is in the TOP-LEVEL tail (after the last
+    # closing paren): an ORDER BY buried in a subquery doesn't order the
+    # outer result, so windows over it would duplicate/drop rows.
+    top_tail = lowered.rsplit(")", 1)[-1]
+    if ("limit" in lowered or "offset" in lowered
+            or "order by" not in top_tail):
         shards = [sql]
     else:
-        # probe the row count once to build balanced windows
+        # probe the row count once to build balanced windows (the count
+        # subquery is aliased: PostgreSQL rejects an unaliased derived
+        # table)
         conn = connection_factory()
         try:
             cur = conn.cursor()
-            cur.execute(f"SELECT COUNT(*) FROM ({sql})")
+            cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS _rt_count")
             n = int(cur.fetchone()[0])
         finally:
             conn.close()

@@ -16,6 +16,7 @@ blocking I/O, no connection cap — VERDICT r3 item 8).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import threading
 from typing import Any, Callable, Dict, Optional
@@ -293,19 +294,50 @@ class ServeIngress:
         # each yield as a chunk as it arrives
         q: asyncio.Queue = asyncio.Queue(maxsize=16)
         _DONE = object()
+        # Set when the consumer stops reading (client disconnect): the pump
+        # must never block forever in put() or it leaks an executor thread
+        # and the deployment iterator per aborted stream.
+        aborted = threading.Event()
+
+        def _pump_put(item) -> bool:
+            """Bounded put from the pump thread; False once the consumer
+            is gone (or the loop died) so the pump unwinds."""
+            while not aborted.is_set():
+                # bounded .result() too: if the loop stops mid-put the
+                # future never resolves and an unbounded wait would
+                # re-create the leaked-thread bug this fixes
+                fut = asyncio.run_coroutine_threadsafe(
+                    asyncio.wait_for(q.put(item), timeout=0.25), loop
+                )
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except (asyncio.TimeoutError, TimeoutError,
+                        concurrent.futures.TimeoutError):
+                    # A retry is only safe if THIS put provably didn't
+                    # land (else the client sees the chunk twice).
+                    if not fut.done() and not fut.cancel():
+                        try:  # completed racing the cancel
+                            fut.result(timeout=0)
+                            return True
+                        except Exception:
+                            pass
+                    if not loop.is_running():
+                        return False
+                    continue
+                except Exception:
+                    return False
+            return False
 
         def pump():
             it = None
             try:
                 it = handle.stream(payload)
                 for item in it:
-                    asyncio.run_coroutine_threadsafe(
-                        q.put({"chunk": item}), loop
-                    ).result()
+                    if not _pump_put({"chunk": item}):
+                        return
             except Exception as e:  # noqa: BLE001 — surfaced in-band
-                asyncio.run_coroutine_threadsafe(
-                    q.put({"error": str(e)}), loop
-                ).result()
+                _pump_put({"error": str(e)})
             finally:
                 close = getattr(it, "close", None)
                 if close:
@@ -313,25 +345,30 @@ class ServeIngress:
                         close()
                     except Exception:
                         pass
-                asyncio.run_coroutine_threadsafe(q.put(_DONE), loop).result()
+                _pump_put(_DONE)
 
         loop.run_in_executor(None, pump)
-        await send({
-            "type": "http.response.start",
-            "status": 200,
-            "headers": [(b"content-type", b"application/jsonl")],
-        })
-        while True:
-            item = await q.get()
-            if item is _DONE:
-                break
+        try:
             await send({
-                "type": "http.response.body",
-                "body": json.dumps(item).encode() + b"\n",
-                "more_body": True,
+                "type": "http.response.start",
+                "status": 200,
+                "headers": [(b"content-type", b"application/jsonl")],
             })
-        await send({"type": "http.response.body", "body": b"",
-                    "more_body": False})
+            while True:
+                item = await q.get()
+                if item is _DONE:
+                    break
+                await send({
+                    "type": "http.response.body",
+                    "body": json.dumps(item).encode() + b"\n",
+                    "more_body": True,
+                })
+            await send({"type": "http.response.body", "body": b"",
+                        "more_body": False})
+        finally:
+            aborted.set()
+            while not q.empty():  # free any put() awaiting a slot
+                q.get_nowait()
 
 
 async def _json_response(send, status: int, obj) -> None:
