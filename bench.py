@@ -379,6 +379,9 @@ def main():
         import os as _os
 
         _os.environ.setdefault("RAYTPU_LEASE_PUSH_PIPELINE_DEPTH", "8")
+        # warm-lease reuse across the timer's bursts (see
+        # config.lease_keepalive_ms; default stays 0)
+        _os.environ.setdefault("RAYTPU_LEASE_KEEPALIVE_MS", "100")
         import ray_tpu
         from ray_tpu._private.ray_perf import run_microbenchmarks
 

@@ -48,9 +48,28 @@ class OrderGate:
         self._draining = False  # single-drainer flag (see _drain)
 
     def submit(self, run: Callable[[], None], ready: bool):
-        ent = {"run": run, "ready": ready}
+        # Fast path: a ready entry hitting an empty, undrained gate runs
+        # immediately under one lock section (the overwhelmingly common
+        # shape — inline-arg pushes at pipelined rates). Ordering holds:
+        # it IS the head, and the drain flag blocks concurrent drainers
+        # until it finishes.
         with self._lock:
-            self._q.append(ent)
+            if ready and not self._q and not self._draining:
+                self._draining = True
+                fast = True
+                ent = None
+            else:
+                fast = False
+                ent = {"run": run, "ready": ready}
+                self._q.append(ent)
+        if fast:
+            try:
+                run()
+            finally:
+                with self._lock:
+                    self._draining = False
+            self._drain()  # entries that queued while we ran
+            return None
         self._drain()
         return ent
 
@@ -198,6 +217,18 @@ class ConduitConnection:
         else:
             self._close_callbacks.append(cb)
 
+    # Back-compat single-slot setter (same contract as rpc.Connection):
+    # the raylet/GCS register worker/node death handlers through this —
+    # a plain attribute here would silently break death detection.
+    @property
+    def on_close(self):
+        return self._close_callbacks[-1] if self._close_callbacks else None
+
+    @on_close.setter
+    def on_close(self, cb):
+        if cb is not None:
+            self.add_close_callback(cb)
+
     @property
     def closed(self):
         return self._closed
@@ -271,6 +302,22 @@ class ConduitConnection:
         self.loop.call_soon_threadsafe(run_cbs)
 
 
+def make_server(addr: str, handler, name: str = "", fast_dispatch=None):
+    """``rpc.Server`` drop-in factory: native conduit engine when built
+    and enabled (``RAYTPU_NATIVE_WIRE``), asyncio transport otherwise.
+    The raylet and GCS daemons serve through this (round 5) so their
+    listener sockets ride the C++ epoll/writev path like workers do —
+    parity: the role of the reference's gRPC servers in raylet/GCS
+    (src/ray/rpc/grpc_server.h)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if GLOBAL_CONFIG.native_wire and conduit.available():
+        return ConduitRpcServer(
+            addr, handler, name=name, fast_dispatch=fast_dispatch
+        )
+    return rpc.Server(addr, handler, name=name)
+
+
 class ConduitRpcServer:
     """Drop-in for rpc.Server on a worker endpoint (same start_async /
     stop_async / addr surface), with an optional ``fast_dispatch`` hook
@@ -290,10 +337,15 @@ class ConduitRpcServer:
         self.name = name
         self.fast_dispatch = fast_dispatch
         self.engine = conduit.Engine.get()
-        self.loop = rpc.EventLoopThread.get().loop
+        # bound at start_async: workers start their server on the shared
+        # IO-loop thread, while the raylet/GCS daemons (round 5) start it
+        # on their own main loop — handlers must run where the process's
+        # state lives
+        self.loop = None
         self.connections: List[ConduitConnection] = []
 
     async def start_async(self):
+        self.loop = asyncio.get_running_loop()
         self.addr = self.engine.listen(self.requested_addr, self._on_accept)
 
     def _on_accept(self, conn_id: int):  # reaper thread

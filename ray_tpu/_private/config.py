@@ -108,12 +108,21 @@ _d("object_transfer_max_concurrent_chunks", int, 4)
 # Raise for flat data-parallel workloads (the perf bench uses 8) —
 # parity: reference max_tasks_in_flight_per_worker lease multiplexing.
 _d("lease_push_pipeline_depth", int, 1)
+# ms an exhausted push loop lingers on its leased worker waiting for new
+# same-shaped tasks before returning it (0 = return immediately). Bursty
+# submitters avoid a full lease round trip per burst — parity: reference
+# idle worker-lease caching (worker_lease_timeout_milliseconds)
+_d("lease_keepalive_ms", int, 0)
 # in-flight pushed calls per ordered actor (round 4 pipelined submitter;
 # the executor's per-caller ticket queue keeps execution submission-order)
 _d("actor_pipeline_depth", int, 256)
 # serve worker task endpoints through the native conduit wire engine
 # (src/conduit/conduit.cpp) when it builds; asyncio transport otherwise
 _d("native_wire", bool, True)
+# conduit reap-queue high-water mark: past this many MB of unreaped
+# frames the engine stops reading sockets (bounded memory under a
+# stalled reaper; backpressure propagates to senders' queues)
+_d("conduit_ev_high_water_mb", int, 512)
 # cap on concurrent lease requests per (resources, strategy) key: enough
 # to saturate a node's parallelism without parking one request per queued
 # task at the raylet (100k-deep queues)

@@ -73,6 +73,27 @@ def _spec_from_slim(wire: List) -> TaskSpec:
     )
 
 
+def _spec_from_slim_plain(wire: List) -> TaskSpec:
+    """Decode the slim PLAIN-task streamed-push wire form (the lease
+    data plane, ``push_task_p`` — see _push_loop for the positional
+    order). Only the fields the executor reads ride the wire; retry
+    bookkeeping stays caller-side."""
+    (task_id, function_id, job_id, name, args, num_returns, owner,
+     trace_ctx, runtime_env) = wire
+    return TaskSpec(
+        task_id=bytes(task_id),
+        function_id=bytes(function_id),
+        job_id=bytes(job_id),
+        name=name,
+        args=args,
+        num_returns=num_returns,
+        resources={},
+        owner=owner,
+        trace_ctx=trace_ctx,
+        runtime_env=runtime_env,
+    )
+
+
 class _StorePin:
     """Owns one outstanding store refcount for a sealed object; released when
     the last deserialized view dies (see serialization._PinnedSlice)."""
@@ -289,6 +310,9 @@ class _LeaseState:
         self.active = 0  # granted leases currently looping
         self.requests_in_flight = 0
         self.strategy = None  # wire-form scheduling strategy for this key
+        # push loops lingering on a warm lease (lease_keepalive_ms):
+        # new submissions wake these before requesting fresh leases
+        self.idle_wakes: set = set()
 
 
 class CoreWorker:
@@ -418,6 +442,9 @@ class CoreWorker:
         self._actor_windows: Dict[bytes, asyncio.Semaphore] = {}
         # streaming push bookkeeping: conn -> {"addr", "specs": {tid: spec}}
         self._inflight_by_conn: Dict[Any, Dict] = {}
+        # streamed LEASE pushes: task_id -> completion cb(ok) waking the
+        # owning _push_loop (loop thread only; see _on_task_done)
+        self._stream_done_cb: Dict[bytes, Any] = {}
         # executor side: conduit conns with batched task_done buffers
         self._done_conns: set = set()
         # cross-thread submit batching (one loop wakeup per burst)
@@ -426,7 +453,9 @@ class CoreWorker:
         self._spawn_scheduled = False
 
         # executor state (worker mode)
-        self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        # SimpleQueue: C-implemented put/get (no Python lock/condvar per
+        # op) — the exec handoff runs at >10k items/s on the actor plane
+        self._exec_queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self._actor_instance = None
         self._actor_id: Optional[bytes] = None
         self._actor_concurrency = 1
@@ -1343,8 +1372,16 @@ class CoreWorker:
         # Late grants that find the queue empty return immediately. The
         # in-flight request count is CAPPED: a deep queue (100k tasks)
         # must not park one lease request per task at the raylet.
+        woken = 0
+        while st.idle_wakes and woken < len(st.queue):
+            # warm leases first: a lingering push loop resumes instantly,
+            # no raylet round trip (lease_keepalive_ms). Wake only as
+            # many as there are queued tasks — waking the whole pool for
+            # one task would churn the spares back to the raylet.
+            st.idle_wakes.pop().set()
+            woken += 1
         want = min(len(st.queue), GLOBAL_CONFIG.max_lease_requests_in_flight)
-        have = st.requests_in_flight
+        have = st.requests_in_flight + woken
         for _ in range(min(want - have, 8)):
             st.requests_in_flight += 1
             asyncio.get_running_loop().create_task(self._lease_loop(key, st))
@@ -1424,21 +1461,45 @@ class CoreWorker:
         at 8) so the push RTT overlaps worker execution — parity:
         reference max_tasks_in_flight_per_worker lease multiplexing.
         Either way the NEXT queued task's plasma args are prefetch-staged
-        on the worker's node while the current one runs."""
+        on the worker's node while the current one runs.
+
+        Round 5: pushes STREAM — one corked ``push_task_p`` notify per
+        task out, completions back as (batched) ``task_done`` notifies
+        handled inline in the read loop, exactly like the actor data
+        plane. The per-push asyncio future + asyncio.wait re-arming of
+        the round-4 request/reply form cost ~30us/task of pure driver
+        overhead at depth 8."""
         worker_addr = grant["worker"]
         lease_id = grant["lease_id"]
         reusable = True
         depth = max(1, GLOBAL_CONFIG.lease_push_pipeline_depth)
-        pending: Dict[asyncio.Task, TaskSpec] = {}
-        loop = asyncio.get_running_loop()
+        inflight = 0
+        wake = asyncio.Event()
+
+        def on_done(ok: bool):
+            nonlocal inflight, reusable
+            inflight -= 1
+            if not ok:
+                reusable = False
+            wake.set()
+
         try:
             try:
                 conn = await self._conn_to(worker_addr[1])
             except Exception:
                 reusable = False
                 return
+            reg = self._inflight_by_conn.get(conn)
+            if reg is None:
+                reg = self._inflight_by_conn[conn] = {
+                    "addr": worker_addr, "specs": {},
+                }
+                conn.sync_notify["task_done"] = self._on_task_done
+                conn.sync_notify["task_done_batch"] = self._on_task_done_batch
+                conn.add_close_callback(self._on_actor_conn_close)
             while True:
-                while reusable and st.queue and len(pending) < depth:
+                pushed = False
+                while reusable and st.queue and inflight < depth:
                     spec = st.queue.popleft()
                     if spec.task_id in self._cancelled:
                         self._cancelled.discard(spec.task_id)
@@ -1457,26 +1518,46 @@ class CoreWorker:
                             self.io.submit(conn.call_async(
                                 "stage_args_hint", nxt, timeout=None
                             ))
-                    t = loop.create_task(conn.call_async(
-                        "push_task", spec.to_wire(), timeout=None
-                    ))
-                    pending[t] = spec
-                if not pending:
-                    break
-                done, _ = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED
-                )
-                for t in done:
-                    spec = pending.pop(t)
+                    reg["specs"][spec.task_id] = spec
+                    self._stream_done_cb[spec.task_id] = on_done
                     try:
-                        reply = t.result()
-                    except Exception as e:
-                        # worker died mid-task; in-flight siblings fail on
-                        # their own as the conn close resolves them
+                        conn.send_notify_corked("push_task_p", [
+                            spec.task_id, spec.function_id, spec.job_id,
+                            spec.name, spec.args, spec.num_returns,
+                            spec.owner, spec.trace_ctx, spec.runtime_env,
+                        ])
+                    except rpc.SendError:
+                        reg["specs"].pop(spec.task_id, None)
+                        self._stream_done_cb.pop(spec.task_id, None)
+                        st.queue.appendleft(spec)  # re-lease elsewhere
                         reusable = False
-                        self._handle_worker_failure(spec, e)
-                        continue
-                    self._handle_task_reply(spec, reply, worker_addr)
+                        break
+                    inflight += 1
+                    pushed = True
+                if pushed:
+                    conn.flush_cork()
+                if inflight == 0 and (not st.queue or not reusable):
+                    keepalive = GLOBAL_CONFIG.lease_keepalive_ms
+                    if not reusable or keepalive <= 0:
+                        break
+                    # linger on the warm lease: a burst submitter's next
+                    # batch reuses this worker without a lease round trip
+                    ev = asyncio.Event()
+                    st.idle_wakes.add(ev)
+                    try:
+                        await asyncio.wait_for(
+                            ev.wait(), keepalive / 1000.0
+                        )
+                    except (asyncio.TimeoutError, TimeoutError):
+                        st.idle_wakes.discard(ev)
+                        break  # keepalive expired: return the worker
+                    st.idle_wakes.discard(ev)
+                    # woken: re-enter the loop — if a sibling already
+                    # drained the queue, linger again rather than churn
+                    # the warm lease back to the raylet
+                    continue
+                await wake.wait()
+                wake.clear()
         finally:
             st.active -= 1
             try:
@@ -1489,6 +1570,36 @@ class CoreWorker:
                 self._maybe_request_lease(key, st)
 
     def _handle_task_reply(self, spec: TaskSpec, reply: Dict, worker_addr):
+        # Fast path: the overwhelmingly common reply — one return, no
+        # errors, no contained refs — skips the zip/enumerate machinery
+        # below (worth ~10us/call at pipelined actor rates).
+        if (
+            spec.num_returns == 1
+            and reply.get("error") is None
+            and not reply.get("system_error")
+            and not reply.get("contained")
+        ):
+            kind, payload = reply["returns"][0]
+            oid = spec.return_ids()[0]
+            if kind == "v":
+                value = serialization.unpack(payload)
+                if isinstance(value, exc.ErrorObject):
+                    self.memory_store.put_error(oid, value.error)
+                else:
+                    self.memory_store.put_value(oid, value)
+            else:
+                self.memory_store.put_plasma(oid, [worker_addr[2]])
+            self._cancelled.discard(spec.task_id)
+            info = self._pending_tasks.pop(spec.task_id, None)
+            self._recovering.discard(spec.task_id)
+            if info and info.get("pinned"):
+                self._pin_handoff(info["pinned"])
+            if GLOBAL_CONFIG.lineage_pinning_enabled:
+                self._lineage[oid] = spec
+                self._pull_failures.pop(oid, None)
+                if info and info.get("pinned"):
+                    self._lineage_pinned[spec.task_id] = info["pinned"]
+            return
         returns = reply.get("returns", [])
         self._cancelled.discard(spec.task_id)  # too late to cancel
         info = self._pending_tasks.get(spec.task_id)
@@ -1714,7 +1825,15 @@ class CoreWorker:
             self._gen_streams[spec.task_id] = stream
             refs = [StreamingObjectRefGenerator(stream, refs[0])]
         self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
-        self._io_spawn(self._enqueue_actor_task(spec))
+        # EVERY submission appends to the per-actor deque synchronously
+        # (GIL-atomic) — the submit thread, not a loop coroutine, fixes
+        # the order, so a mixed fast/slow enqueue can never invert two
+        # calls on an ordered actor. The pump resolves concurrency mode
+        # and only runs when none is active (coroutine-per-call costs
+        # ~15us at pipelined rates).
+        self._actor_queues[actor_id].append(spec)
+        if actor_id not in self._actor_pumping:
+            self._io_spawn(self._actor_pump(actor_id))
         return refs
 
     async def _enqueue_actor_task(self, spec: TaskSpec):
@@ -1733,23 +1852,45 @@ class CoreWorker:
         (reference semantics): their tasks are pushed without waiting for
         earlier replies, so the executor's thread pool / asyncio loop can
         actually interleave them."""
-        if spec.actor_id not in self._actor_conc_cache:
+        self._actor_queues[spec.actor_id].append(spec)
+        await self._actor_pump(spec.actor_id)
+
+    async def _actor_pump(self, aid: bytes):
+        """Drain one actor's queue (single pump per actor; see
+        _enqueue_actor_task's docstring for the pipelining contract).
+        The pump owns the concurrency-mode decision: max_concurrency > 1
+        actors opt OUT of ordering (reference semantics), so their
+        queued specs fan out as concurrent submit coroutines instead of
+        the ordered streaming pushes below."""
+        q = self._actor_queues[aid]
+        if aid in self._actor_pumping or not q:
+            return
+        self._actor_pumping.add(aid)
+        if aid not in self._actor_conc_cache:
             # handle arrived from elsewhere (arg / get_actor): fetch the
             # record first — choosing the ordered pump for a concurrent
             # actor would serialize (or deadlock) wait/signal patterns
-            await self._actor_address(spec.actor_id)
-            self._actor_conc_cache.setdefault(spec.actor_id, 1)
-        if self._actor_conc_cache.get(spec.actor_id, 1) > 1:
-            asyncio.get_running_loop().create_task(
-                self._submit_actor_async(spec)
-            )
+            try:
+                await self._actor_address(aid)
+            except BaseException:
+                # pump must never wedge: deregister so the next submit
+                # re-kicks (queued specs stay queued)
+                self._actor_pumping.discard(aid)
+                raise
+            finally:
+                self._actor_conc_cache.setdefault(aid, 1)
+        if self._actor_conc_cache.get(aid, 1) > 1:
+            try:
+                loop = asyncio.get_running_loop()
+                while q:
+                    loop.create_task(self._submit_actor_async(q.popleft()))
+            finally:
+                self._actor_pumping.discard(aid)
+                if q:
+                    asyncio.get_running_loop().create_task(
+                        self._actor_pump(aid)
+                    )
             return
-        aid = spec.actor_id
-        q = self._actor_queues[aid]
-        q.append(spec)
-        if aid in self._actor_pumping:
-            return
-        self._actor_pumping.add(aid)
         corked = None  # conn holding corked pushes awaiting flush
         ncork = 0
 
@@ -1815,6 +1956,13 @@ class CoreWorker:
             # otherwise (the conn is healthy, so no close-path recovery)
             uncork()
             self._actor_pumping.discard(aid)
+            if q:
+                # a submit-thread append raced the exit (it saw the pump
+                # still registered and skipped the kick): re-kick so the
+                # straggler doesn't strand until the next call
+                asyncio.get_running_loop().create_task(
+                    self._actor_pump(aid)
+                )
 
     async def _actor_address(self, actor_id: bytes, wait_alive=True):
         """Resolve an actor's address. While the actor is PENDING/RESTARTING
@@ -2001,13 +2149,27 @@ class CoreWorker:
             self._on_task_done(conn, entry)
 
     def _on_task_done(self, conn, data):
-        """Inline (read-loop) completion of a streamed actor call."""
+        """Inline (read-loop) completion of a streamed actor or lease
+        call."""
         task_id, reply = data
         reg = self._inflight_by_conn.get(conn)
         if reg is None:
             return
         spec = reg["specs"].pop(bytes(task_id), None)
         if spec is None:
+            return
+        if spec.actor_id is None:
+            # streamed LEASE push: reply semantics (incl. system_error
+            # retries) live in _handle_task_reply; the window slot in
+            # the owning _push_loop MUST free even if reply handling
+            # raises (e.g. an undeserializable return) — a swallowed
+            # exception here would strand the lease forever
+            cb = self._stream_done_cb.pop(spec.task_id, None)
+            try:
+                self._handle_task_reply(spec, reply, reg["addr"])
+            finally:
+                if cb is not None:
+                    cb(not reply.get("system_error"))
             return
         self._release_window(spec.actor_id)
         if reply.get("system_error") and spec.max_retries != 0:
@@ -2029,11 +2191,21 @@ class CoreWorker:
     def _on_actor_conn_close(self, conn):
         """The actor's worker died with streamed calls in flight: same
         semantics as the slow path's mid-call failure — fail with
-        ActorDiedError unless the user opted into max_task_retries."""
+        ActorDiedError unless the user opted into max_task_retries.
+        Streamed LEASE pushes route through the plain-task worker-failure
+        path (retries_left driven) instead."""
         reg = self._inflight_by_conn.pop(conn, None)
         if reg is None:
             return
         for spec in reg["specs"].values():
+            if spec.actor_id is None:
+                self._handle_worker_failure(
+                    spec, ConnectionError("worker connection closed")
+                )
+                cb = self._stream_done_cb.pop(spec.task_id, None)
+                if cb is not None:
+                    cb(False)
+                continue
             self._release_window(spec.actor_id)
             self._actor_addr_cache.pop(spec.actor_id, None)
             if spec.max_retries != 0:
@@ -2134,13 +2306,16 @@ class CoreWorker:
         out-of-order staging. Returns False to route to the loop."""
         if method == "push_task" and kind == 0:  # rpc._REQUEST
             streamed = False
-        elif method in ("push_task_c", "push_task_n") and kind == 3:
+        elif method in ("push_task_c", "push_task_n",
+                        "push_task_p") and kind == 3:
             streamed = True  # rpc._NOTIFY
         else:
             return False
         try:
             if method == "push_task_c":
                 spec = _spec_from_slim(data)
+            elif method == "push_task_p":
+                spec = _spec_from_slim_plain(data)
             else:
                 spec = TaskSpec.from_wire(data)
         except Exception:
@@ -2221,6 +2396,13 @@ class CoreWorker:
     async def rpc_push_task_c(self, conn, wire: List):
         """Slim-wire variant of rpc_push_task_n (asyncio fallback)."""
         spec = _spec_from_slim(wire)
+        reply = await self._pushed_task_reply(conn, spec)
+        await conn.notify_async("task_done", [spec.task_id, reply])
+
+    async def rpc_push_task_p(self, conn, wire: List):
+        """Slim-wire streamed PLAIN-task push (asyncio fallback; conduit
+        workers intercept on the reaper thread in _conduit_fast_push)."""
+        spec = _spec_from_slim_plain(wire)
         reply = await self._pushed_task_reply(conn, spec)
         await conn.notify_async("task_done", [spec.task_id, reply])
 

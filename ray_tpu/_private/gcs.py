@@ -118,7 +118,11 @@ class GcsServer:
         # re-established by raylets re-registering.
         self.storage_path = storage_path
         self._dirty = False
-        self.server = rpc.Server(sock_path, rpc.handler_table(self), name="gcs")
+        from ray_tpu._private.conduit_rpc import make_server
+
+        self.server = make_server(
+            sock_path, rpc.handler_table(self), name="gcs"
+        )
         # tables
         self.kv: Dict[str, bytes] = {}
         self.nodes: Dict[bytes, NodeInfo] = {}

@@ -81,7 +81,11 @@ class Raylet:
         self.labels = labels or {}
         self.total_resources = dict(resources)
         self.available = dict(resources)
-        self.server = rpc.Server(sock_path, rpc.handler_table(self), name="raylet")
+        from ray_tpu._private.conduit_rpc import make_server
+
+        self.server = make_server(
+            sock_path, rpc.handler_table(self), name="raylet"
+        )
         self.store: Optional[SharedMemoryStore] = None
         self.gcs: Optional[rpc.Connection] = None
         # workers
@@ -1273,8 +1277,8 @@ class Raylet:
         self.spilled_bytes = max(0, self.spilled_bytes - len(data))
         try:
             self.spill_storage.delete(uri)
-        except OSError:
-            pass
+        except Exception:  # bucket backends raise beyond OSError; the
+            pass           # restore itself already succeeded
         return True
 
     async def _create_local_with_spill(self, oid, size: int):
@@ -1514,6 +1518,91 @@ class Raylet:
             "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
         }
+
+    # ------------- per-node agent surface (round 5) -------------
+    # Parity: the reference runs a per-node dashboard agent process
+    # (dashboard/agent.py + modules/reporter/reporter_agent.py:266
+    # psutil-based worker stats, modules/log log tailing over HTTP).
+    # Here the raylet IS the per-node daemon, so the collector lives in
+    # it rather than in a sibling process — same data, one less process
+    # to babysit per host.
+
+    @staticmethod
+    def _proc_stats(pid: int):
+        """CPU seconds + RSS bytes for one pid from /proc (no psutil)."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            tick = os.sysconf("SC_CLK_TCK")
+            cpu_s = (int(parts[11]) + int(parts[12])) / tick
+            with open(f"/proc/{pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            return {
+                "cpu_seconds": round(cpu_s, 2),
+                "rss_bytes": rss_pages * os.sysconf("SC_PAGE_SIZE"),
+            }
+        except Exception:
+            return {"cpu_seconds": None, "rss_bytes": None}
+
+    async def rpc_agent_stats(self, conn, _):
+        """Live per-worker process stats + node memory + store fill
+        (reference reporter_agent.py role)."""
+        workers = {}
+        for wid, w in self.workers.items():
+            ws = self._proc_stats(w.proc.pid)
+            ws["pid"] = w.proc.pid
+            ws["idle"] = w in self.idle
+            ws["lease_id"] = (
+                w.lease_id.hex() if w.lease_id is not None else None
+            )
+            workers[wid.hex()[:12]] = ws
+        mem_total = mem_avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                mi = dict(
+                    line.split(":", 1) for line in f.read().splitlines()
+                )
+            mem_total = int(mi["MemTotal"].split()[0]) * 1024
+            mem_avail = int(mi["MemAvailable"].split()[0]) * 1024
+        except Exception:
+            pass
+        store = self.store.stats() if self.store else {}
+        return {
+            "node_id": self.node_id.hex(),
+            "raylet": self._proc_stats(os.getpid()),
+            "workers": workers,
+            "host_mem_total": mem_total,
+            "host_mem_available": mem_avail,
+            "store_bytes_allocated": store.get("bytes_allocated"),
+            "store_capacity": store.get("capacity"),
+            "spilled_bytes": self.spilled_bytes,
+        }
+
+    async def rpc_tail_log(self, conn, req: Dict):
+        """Tail a worker/raylet log file over the control plane
+        (reference dashboard/modules/log HTTP tailing). ``req``:
+        {"proc": "worker-<hex12>" | "raylet", "tail_bytes": n}.
+        The proc name is resolved against this node's OWN log dir only
+        (no path traversal: the name must match a live or past worker
+        or the literal "raylet")."""
+        proc = str(req.get("proc") or "")
+        tail = min(int(req.get("tail_bytes") or 65536), 4 << 20)
+        known = {f"worker-{w.hex()[:12]}" for w in self._ever_workers}
+        known.add("raylet")
+        if proc not in known:
+            return {"error": f"unknown proc {proc!r}", "known":
+                    sorted(known)}
+        path = os.path.join(self.session_dir, "logs", f"{proc}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                data = f.read()
+            return {"proc": proc, "size": size,
+                    "data": data.decode("utf-8", "replace")}
+        except FileNotFoundError:
+            return {"proc": proc, "size": 0, "data": ""}
 
     async def rpc_ping(self, conn, _):
         return "pong"

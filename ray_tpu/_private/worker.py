@@ -9,6 +9,7 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import threading
 from typing import Dict, Optional
 
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -91,11 +92,38 @@ def init(
 
 
 def _detect_tpu_chips() -> int:
-    try:
-        import jax
+    """Count accelerator devices, bounded in time: a wedged TPU tunnel
+    makes ``jax.devices()`` block indefinitely inside PJRT client
+    creation, and init() must degrade to CPU-only rather than hang the
+    whole process (observed with the axon loopback relay; same failure
+    mode as an unreachable libtpu grpc endpoint on a real pod)."""
+    import queue
 
-        return sum(1 for d in jax.devices() if d.platform != "cpu")
-    except Exception:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # explicitly pinned to CPU: never probe the accelerator plugin
+        # (site hooks may override the pin and block on a dead tunnel)
+        return 0
+
+    out: "queue.SimpleQueue" = queue.SimpleQueue()
+
+    def probe():
+        try:
+            import jax
+
+            out.put(sum(1 for d in jax.devices()
+                        if d.platform != "cpu"))
+        except Exception:
+            out.put(0)
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="tpu-detect")
+    t.start()
+    try:
+        timeout = float(os.environ.get(
+            "RAYTPU_TPU_DETECT_TIMEOUT_S", "60"
+        ))
+        return out.get(timeout=timeout)
+    except Exception:  # queue.Empty: tunnel wedged — degrade to CPU
         return 0
 
 

@@ -80,7 +80,12 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, self._method_meta.get(name, 1))
+        m = ActorMethod(self, name, self._method_meta.get(name, 1))
+        # cache: __getattr__ only fires on a miss, so repeated a.method
+        # accesses hit the instance dict (hot at 10k calls/s). __reduce__
+        # pickles only (actor_id, name, meta), so the cache never rides.
+        self.__dict__[name] = m
+        return m
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"

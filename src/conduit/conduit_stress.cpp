@@ -1,0 +1,219 @@
+// Conduit malformed-frame corpus + backpressure stress (single TU:
+// includes conduit.cpp). Built under ASAN/TSAN by
+// tests/test_conduit_hardening.py — the reference leans on gRPC for
+// this whole class of wire-parsing bug; conduit owns its framing, so it
+// owns the fuzz harness too.
+//
+// Covers:
+//   1. valid frames dribbled 1 byte at a time (reassembly across reads)
+//   2. interleaved partial writes of several frames in odd chunk sizes
+//   3. truncated frame then close (no leak, EV_CLOSED, no stray frame)
+//   4. header len > kMaxFrame -> connection destroyed, no malloc bomb
+//   5. zero-length frame
+//   6. stalled reaper: ev_bytes must cap at the high-water mark and the
+//      engine must stop reading (bounded memory) until cd_poll drains,
+//      then resume and deliver everything.
+
+#include "conduit.cpp"
+
+#include <cassert>
+#include <cstdio>
+
+namespace {
+
+int raw_connect_unix(const char* path) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un sa{};
+  sa.sun_family = AF_UNIX;
+  strncpy(sa.sun_path, path, sizeof(sa.sun_path) - 1);
+  if (connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0) { perror("connect"); abort(); }
+  return fd;
+}
+
+void send_all(int fd, const uint8_t* p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = send(fd, p + off, n - off, 0);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      break;  // receiver closed us (expected in the reject case)
+    }
+    off += (size_t)w;
+  }
+}
+
+std::vector<uint8_t> frame(const std::string& body) {
+  std::vector<uint8_t> out(4 + body.size());
+  uint32_t len = (uint32_t)body.size();
+  out[0] = len >> 24; out[1] = len >> 16; out[2] = len >> 8; out[3] = len;
+  memcpy(out.data() + 4, body.data(), body.size());
+  return out;
+}
+
+// Drain events until `want` frames seen or timeout; returns frames seen.
+int drain_frames(void* h, int want, int timeout_ms) {
+  CdEvent evs[64];
+  int seen = 0;
+  int waited = 0;
+  while (seen < want && waited < timeout_ms) {
+    int n = cd_poll(h, 50, evs, 64);
+    if (n == 0) { waited += 50; continue; }
+    for (int i = 0; i < n; i++) {
+      if (evs[i].kind == EV_FRAME) {
+        seen++;
+        cd_free(h, evs[i].data);
+      }
+    }
+  }
+  return seen;
+}
+
+}  // namespace
+
+int main() {
+  setbuf(stdout, NULL);
+  char path[] = "/tmp/conduit_stress_XXXXXX";
+  int tfd = mkstemp(path);
+  close(tfd);
+  std::string addr = std::string("unix:") + path;
+
+  // ---- 1+2: dribble + interleaved partials ----
+  {
+    void* h = cd_engine_new();
+    int32_t port = 0;
+    int64_t lid = cd_listen(h, addr.c_str(), &port);
+    assert(lid > 0);
+    int fd = raw_connect_unix(path);
+    auto f1 = frame("hello"), f2 = frame(std::string(3000, 'x'));
+    std::vector<uint8_t> all;
+    for (int r = 0; r < 50; r++) {
+      all.insert(all.end(), f1.begin(), f1.end());
+      all.insert(all.end(), f2.begin(), f2.end());
+    }
+    // dribble the first 200 bytes one at a time, then odd-size chunks
+    size_t off = 0;
+    for (; off < 200; off++) send_all(fd, all.data() + off, 1);
+    for (size_t chunk = 7; off < all.size(); chunk = (chunk * 3) % 97 + 1) {
+      size_t n = std::min(chunk, all.size() - off);
+      send_all(fd, all.data() + off, n);
+      off += n;
+    }
+    int seen = drain_frames(h, 100, 5000);
+    assert(seen == 100);
+    close(fd);
+    cd_engine_stop(h);
+    printf("dribble+interleave ok\n");
+  }
+
+  // ---- 3: truncated frame then close ----
+  {
+    void* h = cd_engine_new();
+    int32_t port = 0;
+    cd_listen(h, addr.c_str(), &port);
+    int fd = raw_connect_unix(path);
+    auto f = frame("complete");
+    send_all(fd, f.data(), f.size());
+    uint8_t trunc[6] = {0, 0, 0, 100, 'a', 'b'};  // claims 100, sends 2
+    send_all(fd, trunc, sizeof(trunc));
+    close(fd);
+    CdEvent evs[16];
+    int frames = 0, closed = 0, waited = 0;
+    while (closed == 0 && waited < 5000) {
+      int n = cd_poll(h, 50, evs, 16);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_FRAME) { frames++; cd_free(h, evs[i].data); }
+        if (evs[i].kind == EV_CLOSED) closed++;
+      }
+    }
+    assert(frames == 1 && closed == 1);
+    cd_engine_stop(h);
+    printf("truncated+close ok\n");
+  }
+
+  // ---- 4: giant length header rejected, no allocation ----
+  {
+    void* h = cd_engine_new();
+    int32_t port = 0;
+    cd_listen(h, addr.c_str(), &port);
+    int fd = raw_connect_unix(path);
+    uint8_t hdr[8] = {0xFF, 0xFF, 0xFF, 0xFF, 'b', 'o', 'o', 'm'};
+    send_all(fd, hdr, sizeof(hdr));
+    CdEvent evs[16];
+    int closed = 0, frames = 0, waited = 0;
+    while (closed == 0 && waited < 5000) {
+      int n = cd_poll(h, 50, evs, 16);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_CLOSED) closed++;
+        if (evs[i].kind == EV_FRAME) { frames++; cd_free(h, evs[i].data); }
+      }
+    }
+    assert(closed == 1 && frames == 0);
+    close(fd);
+    cd_engine_stop(h);
+    printf("giant-len reject ok\n");
+  }
+
+  // ---- 5: zero-length frame ----
+  {
+    void* h = cd_engine_new();
+    int32_t port = 0;
+    cd_listen(h, addr.c_str(), &port);
+    int fd = raw_connect_unix(path);
+    uint8_t z[4] = {0, 0, 0, 0};
+    send_all(fd, z, 4);
+    int seen = drain_frames(h, 1, 3000);
+    assert(seen == 1);
+    close(fd);
+    cd_engine_stop(h);
+    printf("zero-len ok\n");
+  }
+
+  // ---- 6: stalled reaper -> bounded ev queue + resume ----
+  {
+    void* h = cd_engine_new();
+    cd_set_ev_high_water(h, 256 * 1024);  // small cap for the test
+    int32_t port = 0;
+    cd_listen(h, addr.c_str(), &port);
+    int fd = raw_connect_unix(path);
+    set_nonblock(fd);
+    auto f = frame(std::string(4096, 'y'));
+    // blast ~16MB WITHOUT reaping; non-blocking sender stops when the
+    // receiver's socket buffer fills (backpressure reached the wire)
+    size_t sent_frames = 0, stalled = 0;
+    for (int i = 0; i < 4096 && stalled < 200; i++) {
+      size_t off = 0;
+      while (off < f.size()) {
+        ssize_t w = send(fd, f.data() + off, f.size() - off, 0);
+        if (w < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            stalled++;
+            usleep(10000);
+            if (stalled >= 200) break;  // wire is full: proof enough
+            continue;
+          }
+          break;
+        }
+        off += (size_t)w;
+      }
+      if (off == f.size()) sent_frames++;
+    }
+    usleep(200000);  // let the engine ingest whatever it will
+    int64_t buffered = cd_ev_bytes(h);
+    // bounded: queue holds at most high-water + one read chunk
+    assert(buffered <= (int64_t)(256 * 1024 + kReadChunk + 8192));
+    assert(stalled >= 200);  // the sender really was backpressured
+    // reaper wakes up: everything sent must eventually be delivered
+    int seen = drain_frames(h, (int)sent_frames, 20000);
+    assert(seen == (int)sent_frames);
+    close(fd);
+    cd_engine_stop(h);
+    printf("high-water backpressure ok (buffered=%lld of %zu frames)\n",
+           (long long)buffered, sent_frames);
+  }
+
+  unlink(path);
+  printf("conduit stress ok\n");
+  return 0;
+}
