@@ -1,0 +1,189 @@
+"""Local HTTP fake of the TPU queued-resources REST API + metadata server.
+
+Backs ``tests/test_cloud_rest.py``: the real ``RestTpuApi`` urllib client
+talks to this server over loopback exactly as it would talk to
+``tpu.googleapis.com/v2`` — same paths, same JSON shapes, same ADC token
+handshake — while the grant lifecycle underneath is the in-memory
+``MockTpuApi`` state machine (async grants, stockouts, injected
+failures). Parity: the reference tests its GCP provider against mocked
+discovery clients (python/ray/tests/gcp/test_gcp_node_provider.py); here
+the fake sits one layer lower (HTTP), so the whole client rides in test.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ray_tpu.cloud_provider import MockTpuApi
+
+TOKEN = "fake-adc-token"
+
+_QR_RE = re.compile(
+    r"^/v2/projects/([^/]+)/locations/([^/]+)/queuedResources(?:/([^/?]+))?$"
+)
+_NODE_RE = re.compile(
+    r"^/v2/projects/([^/]+)/locations/([^/]+)/nodes/([^/?]+)$"
+)
+
+
+class QrApiFake:
+    """The server plus knobs the tests turn (fail_next_http -> 500s)."""
+
+    def __init__(self, **mock_kwargs):
+        self.mock = MockTpuApi(**mock_kwargs)
+        self.fail_next_http = 0
+        self.requests_seen = []  # (method, path) log
+        self.token_fetches = 0
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _qr_json(self, qr):
+                name = qr["name"]
+                return {
+                    "name": (
+                        f"projects/p/locations/z/queuedResources/{name}"
+                    ),
+                    "state": {"state": qr["state"]},
+                    **({"spot": {}} if qr.get("spot") else {}),
+                    "tpu": {"nodeSpec": [{
+                        "parent": "projects/p/locations/z",
+                        "nodeId": f"{name}-node",
+                        "node": {
+                            "acceleratorType": qr.get(
+                                "accelerator_type", ""
+                            ),
+                            "runtimeVersion": qr.get(
+                                "runtime_version", ""
+                            ),
+                        },
+                    }]},
+                }
+
+            def _gate(self) -> bool:
+                """Auth + failure injection shared by every API route."""
+                if self.headers.get("Authorization") != f"Bearer {TOKEN}":
+                    self._json(401, {"error": "bad or missing token"})
+                    return False
+                if fake.fail_next_http > 0:
+                    fake.fail_next_http -= 1
+                    self._json(500, {"error": "injected transient"})
+                    return False
+                return True
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                fake.requests_seen.append(("GET", parsed.path))
+                if parsed.path == "/token":
+                    if self.headers.get("Metadata-Flavor") != "Google":
+                        self._json(403, {"error": "no Metadata-Flavor"})
+                        return
+                    fake.token_fetches += 1
+                    self._json(200, {"access_token": TOKEN,
+                                     "expires_in": 3600})
+                    return
+                if not self._gate():
+                    return
+                m = _QR_RE.match(parsed.path)
+                if m and m.group(3):
+                    qr = fake.mock.get_queued_resource(m.group(3))
+                    if qr is None:
+                        self._json(404, {"error": "not found"})
+                        return
+                    self._json(200, self._qr_json(qr))
+                    return
+                if m:
+                    self._json(200, {"queuedResources": [
+                        self._qr_json(q)
+                        for q in fake.mock.list_queued_resources()
+                    ]})
+                    return
+                n = _NODE_RE.match(parsed.path)
+                if n:
+                    qr_name = n.group(3).removesuffix("-node")
+                    vms = fake.mock.list_nodes(qr_name)
+                    if not vms:
+                        self._json(404, {"error": "node not ready"})
+                        return
+                    self._json(200, {
+                        "name": n.group(3),
+                        "state": "READY",
+                        "networkEndpoints": [
+                            {"ipAddress": vm["ip"]} for vm in vms
+                        ],
+                    })
+                    return
+                self._json(404, {"error": f"no route {parsed.path}"})
+
+            def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                fake.requests_seen.append(("POST", parsed.path))
+                if not self._gate():
+                    return
+                m = _QR_RE.match(parsed.path)
+                if not (m and not m.group(3)):
+                    self._json(404, {"error": f"no route {parsed.path}"})
+                    return
+                q = urllib.parse.parse_qs(parsed.query)
+                name = q.get("queuedResourceId", [""])[0]
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n)) if n else {}
+                spec = ((body.get("tpu") or {}).get("nodeSpec") or [{}])[0]
+                node = spec.get("node") or {}
+                fake.mock.create_queued_resource(
+                    name,
+                    accelerator_type=node.get("acceleratorType", ""),
+                    runtime_version=node.get("runtimeVersion", ""),
+                    spot="spot" in body,
+                )
+                self._json(200, {"name": f"operations/op-{name}",
+                                 "done": False})
+
+            def do_DELETE(self):
+                parsed = urllib.parse.urlparse(self.path)
+                fake.requests_seen.append(("DELETE", parsed.path))
+                if not self._gate():
+                    return
+                m = _QR_RE.match(parsed.path)
+                if m and m.group(3):
+                    fake.mock.delete_queued_resource(m.group(3))
+                    self._json(200, {"name": "operations/op-del",
+                                     "done": False})
+                    return
+                self._json(404, {"error": f"no route {parsed.path}"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/v2"
+
+    @property
+    def token_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/token"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
