@@ -82,8 +82,9 @@ def _api(path: str):
     raise KeyError(path)
 
 
-def _node_detail(node_id_hex: str):
-    import ray_tpu
+def _raylet_call(node_id_hex: str, method: str, arg=None):
+    """One RPC against the raylet of the node whose id starts with
+    ``node_id_hex``; returns (node_record, reply)."""
     import ray_tpu._private.rpc as rpc_mod
     from ray_tpu._private.worker import require_connected
 
@@ -92,16 +93,41 @@ def _node_detail(node_id_hex: str):
         if bytes(n["node_id"]).hex().startswith(node_id_hex):
             client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
             try:
-                stats = client.call("node_stats", None, timeout=10)
+                return n, client.call(method, arg, timeout=10)
             finally:
                 client.close()
-            return {
-                "node_id": bytes(n["node_id"]).hex(),
-                "raylet_addr": n["raylet_addr"],
-                "alive": n.get("alive", True),
-                "stats": stats,
-            }
     raise KeyError(f"node/{node_id_hex}")
+
+
+def _node_detail(node_id_hex: str):
+    n, stats = _raylet_call(node_id_hex, "node_stats")
+    # round-5 per-node agent surface: live per-worker CPU/RSS, host
+    # memory, store fill (reference reporter_agent.py:266 role — see
+    # raylet.rpc_agent_stats)
+    try:
+        _, agent = _raylet_call(node_id_hex, "agent_stats")
+    except Exception:  # older raylet without the agent surface
+        agent = None
+    return {
+        "node_id": bytes(n["node_id"]).hex(),
+        "raylet_addr": n["raylet_addr"],
+        "alive": n.get("alive", True),
+        "stats": stats,
+        "agent": agent,
+    }
+
+
+def _tail_logs(query: dict):
+    """/api/logs?node=<hex>&proc=<worker-xxxx|raylet>&tail=<bytes> —
+    HTTP log tailing (reference dashboard/modules/log role)."""
+    node = (query.get("node") or [""])[0]
+    proc = (query.get("proc") or ["raylet"])[0]
+    tail = int((query.get("tail") or ["65536"])[0])
+    if not node:
+        raise KeyError("logs: ?node=<hex> is required")
+    _, reply = _raylet_call(node, "tail_log",
+                            {"proc": proc, "tail_bytes": tail})
+    return reply
 
 
 def _prometheus_text() -> str:
@@ -159,6 +185,14 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> str:
                 elif self.path == "/metrics":
                     body = _prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/api/logs"):
+                    import urllib.parse
+
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    body = json.dumps(_tail_logs(q), default=str).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/api/"):
                     body = json.dumps(
                         _api(self.path[len("/api/"):].strip("/")),

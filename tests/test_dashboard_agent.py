@@ -1,0 +1,109 @@
+"""Per-node agent stats + HTTP log tailing (VERDICT r4 item 5).
+
+Parity: reference dashboard/agent.py + modules/reporter
+(reporter_agent.py:266 per-worker stats) + modules/log (HTTP tailing) —
+here served by the raylet (the per-node daemon) and fronted by the
+dashboard's /api/node/<id> and /api/logs routes.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture()
+def two_node_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"resources": {"CPU": 2}})
+    c.add_node(resources={"CPU": 2})
+    c.connect()
+    try:
+        import time
+
+        from ray_tpu.util import state
+
+        deadline = time.monotonic() + 30
+        while (len(state.list_nodes()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert len(state.list_nodes()) == 2, "second node never joined"
+        yield c
+    finally:
+        c.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def test_agent_stats_and_log_tail_two_nodes(two_node_cluster):
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def work():
+        print("hello from the worker log")
+        return 1
+
+    # run work so workers exist and logs have content
+    assert sum(ray_tpu.get([work.remote() for _ in range(8)],
+                           timeout=60)) == 8
+
+    url = start_dashboard()
+    try:
+        nodes = state.list_nodes()
+        assert len(nodes) == 2
+        saw_worker_stats = 0
+        for n in nodes:
+            nid = n["node_id"][:12]
+            detail = _get(f"{url}/api/node/{nid}")
+            agent = detail["agent"]
+            assert agent is not None
+            # raylet self-stats are always present and real
+            assert agent["raylet"]["rss_bytes"] > 1 << 20
+            assert agent["host_mem_total"] > 0
+            # live per-worker stats: pid + rss for every pooled worker
+            for wid, ws in agent["workers"].items():
+                assert ws["pid"] > 0
+                if ws["rss_bytes"]:
+                    assert ws["rss_bytes"] > 1 << 20
+                    saw_worker_stats += 1
+            # log tailing: the raylet knows its procs; tail one worker
+            if agent["workers"]:
+                proc = f"worker-{next(iter(agent['workers']))}"
+                logs = _get(
+                    f"{url}/api/logs?node={nid}&proc={proc}&tail=4096"
+                )
+                assert "data" in logs and "error" not in logs
+        assert saw_worker_stats > 0, "no live worker stats collected"
+
+        # unknown proc is rejected with the known list (no traversal)
+        nid = nodes[0]["node_id"][:12]
+        bad = _get(f"{url}/api/logs?node={nid}&proc=../../etc/passwd")
+        assert "error" in bad and "known" in bad
+    finally:
+        stop_dashboard()
+
+
+def test_agent_stats_direct_rpc(two_node_cluster):
+    """The raylet agent surface works over a bare control-plane RPC
+    (what a remote head's dashboard would do)."""
+    import ray_tpu._private.rpc as rpc_mod
+    from ray_tpu._private.worker import require_connected
+
+    gcs = require_connected().gcs
+    nodes = gcs.call("get_all_nodes", None, timeout=10)
+    assert len(nodes) == 2
+    for n in nodes:
+        client = rpc_mod.Client.connect(n["raylet_addr"], timeout=5)
+        try:
+            stats = client.call("agent_stats", None, timeout=10)
+        finally:
+            client.close()
+        assert stats["node_id"] == bytes(n["node_id"]).hex()
+        assert stats["raylet"]["cpu_seconds"] is not None
