@@ -15,20 +15,20 @@ from ray_tpu.data.dataset import (  # noqa: F401
     GroupedData,
     from_items,
     range,  # noqa: A004 — parity with ray.data.range
-    read_binary_files,
-    read_text,
 )
 from ray_tpu.data.io import (  # noqa: F401
     from_arrow,
     from_huggingface,
     from_numpy,
     from_pandas,
+    read_binary_files,
     read_csv,
     read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_sql,
+    read_text,
     read_tfrecords,
 )
 from ray_tpu.data.webdataset import (  # noqa: F401

@@ -402,6 +402,19 @@ class Dataset:
             self.iter_native_blocks(**kw), batch_size, batch_format
         )
 
+    def iter_device_batches(self, batch_size: int = 256, *,
+                            prefetch_batches: int = 2,
+                            sharding=None) -> Iterator:
+        """Double-buffered ``jax.device_put`` batch feed — see
+        DataIterator.iter_device_batches (same contract, single
+        consumer)."""
+        from ray_tpu.data.iterator import _device_batches
+
+        return _device_batches(
+            lambda: self.iter_batches(batch_size, batch_format="numpy"),
+            prefetch_batches, sharding,
+        )
+
     def take(self, n: int = 20) -> List:
         out = []
         for row in self.iter_rows():
@@ -550,27 +563,6 @@ def _path_blocks(paths, parallelism: int) -> List:
     ] or [ray_tpu.put([])]
 
 
-def read_text(paths: List[str], parallelism: int = 8) -> Dataset:
-    """Line items; files are opened inside tasks (not the driver)."""
-
-    def load(block):
-        out = []
-        for path in block:
-            with open(path) as f:
-                out.extend(line.rstrip("\n") for line in f)
-        return out
-
-    return Dataset(_path_blocks(paths, parallelism),
-                   [Stage("read_text", load)])
-
-
-def read_binary_files(paths: List[str], parallelism: int = 8) -> Dataset:
-    def load(block):
-        out = []
-        for path in block:
-            with open(path, "rb") as f:
-                out.append(f.read())
-        return out
-
-    return Dataset(_path_blocks(paths, parallelism),
-                   [Stage("read_binary", load)])
+# read_text / read_binary_files moved to data/io.py (round 5): the
+# reference row shapes ({"text": line} / {"bytes", "path"}) plus
+# directory expansion live there with the other file readers.

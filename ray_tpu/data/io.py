@@ -118,6 +118,39 @@ def read_json(paths, parallelism: int = 8):
     return _reader_dataset(paths, parallelism, "read_json", _load_json)
 
 
+def _load_text(paths: List[str]) -> List[Dict[str, str]]:
+    out: List[Dict[str, str]] = []
+    for path in paths:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                out.append({"text": line.rstrip("\n")})
+    return out
+
+
+def read_text(paths, parallelism: int = 8):
+    """One row per line: {"text": line} (reference read_text,
+    read_api.py:1514 — lines keyed under a single text column)."""
+    return _reader_dataset(paths, parallelism, "read_text", _load_text)
+
+
+def _load_binary(paths: List[str]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path, "rb") as f:
+            out.append({"bytes": f.read(), "path": path})
+    return out
+
+
+def read_binary_files(paths, parallelism: int = 8):
+    """One row per file: {"bytes": ..., "path": ...} (reference
+    read_binary_files, read_api.py:1676 — include_paths variant's
+    shape, since the path costs nothing and the reference's flag only
+    strips it)."""
+    return _reader_dataset(
+        paths, parallelism, "read_binary_files", _load_binary
+    )
+
+
 def read_parquet(paths, parallelism: int = 8,
                  columns: Optional[List[str]] = None):
     def load(block, _cols=columns):

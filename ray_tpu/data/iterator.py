@@ -117,3 +117,83 @@ class DataIterator:
         return batches_from_blocks(
             self.iter_native_blocks(), batch_size, batch_format
         )
+
+    def iter_device_batches(self, batch_size: int = 256, *,
+                            prefetch_batches: int = 2,
+                            sharding=None) -> Iterator:
+        """Double-buffered device feed: a background thread fetches the
+        NEXT numpy batch and ``jax.device_put``s it while the device
+        step consumes the current one, so host decode + the host->device
+        transfer (a 150-200ms sync on a tunneled TPU) overlaps compute
+        instead of serializing with it.
+
+        Parity: reference ``iter_torch_batches(prefetch_batches=...)``
+        (python/ray/data/iterator.py) — the same pipeline role, with
+        ``jax.device_put`` (optionally to a ``NamedSharding`` for SPMD
+        ingestion) in place of the torch CUDA-stream copy.
+
+        ``prefetch_batches`` bounds in-flight device batches (device
+        memory = prefetch_batches + 1 live batches).
+        """
+        return _device_batches(
+            lambda: self.iter_batches(batch_size, batch_format="numpy"),
+            prefetch_batches, sharding,
+        )
+
+
+def _device_batches(batch_iter_factory, prefetch_batches: int,
+                    sharding) -> Iterator:
+    """Shared double-buffer pump for Dataset/DataIterator
+    iter_device_batches (see the DataIterator docstring)."""
+    import queue
+    import threading
+
+    import jax
+
+    if prefetch_batches < 1:
+        raise ValueError("prefetch_batches must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
+    _END = object()
+    # Abandoned-consumer guard (same class of bug as the serve/asgi
+    # stream pump): a train loop that breaks out early drops the
+    # generator — the pump must unwind, not block in q.put pinning
+    # device buffers + the source iterator forever.
+    aborted = threading.Event()
+
+    def _put(item) -> bool:
+        while not aborted.is_set():
+            try:
+                q.put(item, timeout=0.25)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def pump():
+        try:
+            for batch in batch_iter_factory():
+                if sharding is not None:
+                    dev = jax.device_put(batch, sharding)
+                else:
+                    dev = jax.device_put(batch)
+                if not _put(dev):
+                    return
+            _put(_END)
+        except BaseException as e:  # surfaced to the consumer
+            _put(("__raytpu_prefetch_error__", e))
+
+    threading.Thread(target=pump, daemon=True,
+                     name="device-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__raytpu_prefetch_error__"):
+                raise item[1]
+            yield item
+    finally:
+        aborted.set()
+        while not q.empty():  # free a pump blocked awaiting a slot
+            q.get_nowait()

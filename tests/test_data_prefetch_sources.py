@@ -1,0 +1,99 @@
+"""read_text / read_binary_files round-trips + device-put prefetch
+overlap (VERDICT r4 item 6).
+
+Parity: reference read_api.py:1514 (read_text), :1676
+(read_binary_files), and iter_torch_batches(prefetch_batches=...) —
+here iter_device_batches over jax.device_put.
+"""
+
+import time
+
+import pytest
+
+
+def test_read_text_roundtrip(rt, tmp_path):
+    import ray_tpu.data as rd
+
+    (tmp_path / "a.txt").write_text("alpha\nbeta\n")
+    (tmp_path / "b.txt").write_text("gamma\n")
+    ds = rd.read_text(str(tmp_path))  # directory expansion
+    rows = ds.take_all()
+    assert rows == [{"text": "alpha"}, {"text": "beta"},
+                    {"text": "gamma"}]
+    # single-file form
+    one = rd.read_text(str(tmp_path / "b.txt")).take_all()
+    assert one == [{"text": "gamma"}]
+
+
+def test_read_binary_files_roundtrip(rt, tmp_path):
+    import ray_tpu.data as rd
+
+    (tmp_path / "x.bin").write_bytes(b"\x00\x01\x02")
+    (tmp_path / "y.bin").write_bytes(b"hello")
+    rows = rd.read_binary_files(
+        [str(tmp_path / "x.bin"), str(tmp_path / "y.bin")]
+    ).take_all()
+    assert [r["bytes"] for r in rows] == [b"\x00\x01\x02", b"hello"]
+    assert rows[0]["path"].endswith("x.bin")
+
+
+def test_iter_device_batches_values(rt):
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    ds = rd.from_numpy(np.arange(100, dtype=np.int32))
+    got = []
+    for batch in ds.iter_device_batches(batch_size=32):
+        # device arrays: jax.Array with a device
+        assert hasattr(batch, "devices") or hasattr(batch, "sharding")
+        got.extend(np.asarray(batch).tolist())
+    assert sorted(got) == list(range(100))
+
+
+def test_iter_device_batches_overlaps_host_and_consumer(rt):
+    """The double buffer must overlap host-side batch production with
+    the consumer's step: with per-batch host cost H and consumer cost
+    C, serial time is N*(H+C); overlapped is ~N*max(H,C)."""
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    H = C = 0.05
+    n = 8
+
+    def slow_host(b):
+        time.sleep(H)  # stand-in for decode/augment cost
+        return b
+
+    ds = rd.range(n * 16, parallelism=n).map_batches(slow_host)
+    # warm the pipeline machinery once (worker spawn etc.)
+    _ = list(ds.iter_batches(batch_size=16))
+
+    t0 = time.perf_counter()
+    seen = 0
+    for _batch in ds.iter_device_batches(batch_size=16,
+                                         prefetch_batches=2):
+        time.sleep(C)  # stand-in for the device step
+        seen += 1
+    overlapped = time.perf_counter() - t0
+    assert seen == n
+    serial_floor = n * (H + C)
+    # require >=25% saving vs fully-serial (generous: the streaming
+    # executor already pipelines some production)
+    assert overlapped < serial_floor * 0.75, (
+        f"no overlap: {overlapped:.2f}s vs serial {serial_floor:.2f}s"
+    )
+
+
+def test_iter_device_batches_propagates_errors(rt):
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    def boom(b):
+        raise RuntimeError("decode failed")
+
+    ds = rd.from_numpy(np.arange(8)).map_batches(boom)
+    with pytest.raises(Exception, match="decode failed"):
+        list(ds.iter_device_batches(batch_size=4))
