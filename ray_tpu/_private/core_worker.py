@@ -2626,10 +2626,13 @@ class CoreWorker:
     # ================= runtime envs =================
     # Parity: reference runtime_env (env_vars + working_dir zipped through
     # the GCS KV and cached per node — python/ray/_private/runtime_env/
-    # working_dir.py). conda/pip/containers are out of scope (no installs
-    # in this environment); unknown keys raise.
+    # working_dir.py; pip via a cached venv per requirements hash —
+    # runtime_env/pip.py + the per-node agent's create path,
+    # runtime_env_agent.py:159). conda/containers remain out of scope
+    # (no container runtime in this wheel's environments); unknown keys
+    # raise.
 
-    _RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+    _RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "pip"}
 
     def _process_runtime_env(self, runtime_env: Optional[Dict]) -> Optional[Dict]:
         """Driver side: validate + upload working_dir; returns wire form."""
@@ -2645,6 +2648,18 @@ class CoreWorker:
         env_vars = runtime_env.get("env_vars")
         if env_vars:
             wire["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+        pip = runtime_env.get("pip")
+        if pip:
+            if isinstance(pip, dict):  # reference {"packages": [...]} form
+                pip = pip.get("packages") or []
+            if not isinstance(pip, (list, tuple)) or not all(
+                isinstance(r, str) for r in pip
+            ):
+                raise ValueError(
+                    "runtime_env pip must be a list of requirement "
+                    f"strings (got {pip!r})"
+                )
+            wire["pip"] = list(pip)
         wdir = runtime_env.get("working_dir")
         if wdir:
             if not os.path.isdir(wdir):
@@ -2693,8 +2708,8 @@ class CoreWorker:
         return cache
 
     def _apply_runtime_env(self, spec: TaskSpec, permanent: bool = False):
-        """Apply env_vars/working_dir; returns a restore callable (no-op
-        when permanent — actor creation keeps its env for life)."""
+        """Apply env_vars/working_dir/pip; returns a restore callable
+        (no-op when permanent — actor creation keeps its env for life)."""
         renv = spec.runtime_env
         if not renv:
             return lambda: None
@@ -2703,7 +2718,24 @@ class CoreWorker:
             saved_env[k] = os.environ.get(k)
             os.environ[k] = v
         saved_cwd = None
-        added_path = None
+        added_paths: List[str] = []
+        reqs = renv.get("pip")
+        if reqs:
+            try:
+                site_dir = self._materialize_pip_env(tuple(reqs))
+            except BaseException:
+                # env setup failed AFTER env_vars landed: restore them or
+                # they silently leak into every later task on this worker
+                for k, old in saved_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                raise
+            import sys as _sys
+
+            _sys.path.insert(0, site_dir)
+            added_paths.append(site_dir)
         key = renv.get("working_dir_key")
         if key:
             path = self._materialize_working_dir(key)
@@ -2712,7 +2744,7 @@ class CoreWorker:
             import sys as _sys
 
             _sys.path.insert(0, path)
-            added_path = path
+            added_paths.append(path)
         if permanent:
             return lambda: None
 
@@ -2724,25 +2756,103 @@ class CoreWorker:
                     os.environ[k] = old
             if saved_cwd is not None:
                 os.chdir(saved_cwd)
-            if added_path is not None:
+            if added_paths:
                 import sys as _sys
 
-                try:
-                    _sys.path.remove(added_path)
-                except ValueError:
-                    pass
-                # evict modules imported FROM the working_dir: a later task
-                # with a different working_dir must not see stale code
-                for mod_name in [
-                    m for m, mod in list(_sys.modules.items())
-                    if getattr(mod, "__file__", None)
-                    and str(getattr(mod, "__file__")).startswith(
-                        added_path + os.sep
-                    )
-                ]:
-                    _sys.modules.pop(mod_name, None)
+                for p in added_paths:
+                    try:
+                        _sys.path.remove(p)
+                    except ValueError:
+                        pass
+                    # evict modules imported FROM the env dir: a later
+                    # task with a different env must not see stale code
+                    for mod_name in [
+                        m for m, mod in list(_sys.modules.items())
+                        if getattr(mod, "__file__", None)
+                        and str(getattr(mod, "__file__")).startswith(
+                            p + os.sep
+                        )
+                    ]:
+                        _sys.modules.pop(mod_name, None)
 
         return restore
+
+    @staticmethod
+    def _materialize_pip_env(reqs: tuple) -> str:
+        """Cached env-per-requirements-hash (reference runtime_env/pip.py
+        + runtime_env_agent.py:159): first use on a node pip-installs
+        the requirement list into a content-addressed ``--target`` dir;
+        every later worker re-uses the cache. The dir is PREPENDED to
+        sys.path, layering the env on top of the base exactly like the
+        reference's virtualenv activation (``python -m venv`` is
+        deliberately not used: this interpreter is itself a venv, and a
+        venv-from-venv resolves "system site" to the bare base install).
+        Entries starting with '-' pass through as pip options (e.g.
+        --no-build-isolation for offline local-dir installs)."""
+        import fcntl
+        import shutil
+        import subprocess
+        import sys as _sys
+
+        # hash ignores requirement ORDER (['a','b'] == ['b','a']) but pip
+        # receives the original order (option flags are positional)
+        env_hash = hashlib.sha256(
+            ("\n".join(sorted(reqs)) + _sys.version).encode()
+        ).hexdigest()[:16]
+        base = os.environ.get(
+            "RAYTPU_PIP_CACHE_DIR", "/tmp/raytpu_pip_envs"
+        )
+        os.makedirs(base, exist_ok=True)
+        env_dir = os.path.join(base, env_hash)
+        marker = os.path.join(env_dir, ".raytpu_ready")
+        if os.path.exists(marker):
+            return env_dir
+        lock_path = os.path.join(base, f".{env_hash}.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(marker):  # a sibling built it
+                    return env_dir
+                # Build into a tmp dir and rename (the working_dir
+                # materializer's pattern): a killed/failed install must
+                # never leave a half-written dir that a retry's pip
+                # silently accepts and the marker then blesses.
+                tmp_dir = f"{env_dir}.tmp.{os.getpid()}"
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                shutil.rmtree(env_dir, ignore_errors=True)  # stale partial
+                # site hooks (PYTHONPATH plugins) must not leak into the
+                # build: a TPU-plugin sitecustomize aborts bare helpers
+                clean_env = {
+                    k: v for k, v in os.environ.items()
+                    if k != "PYTHONPATH"
+                }
+                try:
+                    r = subprocess.run(
+                        [_sys.executable, "-m", "pip", "install", "-q",
+                         "--no-warn-script-location", "--target", tmp_dir,
+                         *reqs],
+                        capture_output=True, text=True, timeout=1800,
+                        env=clean_env,
+                    )
+                except subprocess.TimeoutExpired as e:
+                    shutil.rmtree(tmp_dir, ignore_errors=True)
+                    raise RuntimeError(
+                        f"pip install failed for runtime env "
+                        f"{list(reqs)}: timed out after 1800s"
+                    ) from e
+                if r.returncode != 0:
+                    shutil.rmtree(tmp_dir, ignore_errors=True)
+                    raise RuntimeError(
+                        f"pip install failed for runtime env "
+                        f"{list(reqs)}: {r.stderr[-1500:]}"
+                    )
+                with open(os.path.join(tmp_dir, ".raytpu_ready"),
+                          "w") as f:
+                    f.write("\n".join(reqs))
+                os.rename(tmp_dir, env_dir)
+                return env_dir
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
 
     def _decode_args(self, spec: TaskSpec):
         args = []

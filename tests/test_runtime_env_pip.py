@@ -1,0 +1,156 @@
+"""Pip runtime env: cached env-per-requirements-hash (VERDICT r4 item 9).
+
+Parity: reference python/ray/_private/runtime_env/pip.py + the per-node
+agent create path (runtime_env_agent.py:159). No network in CI, so the
+requirement is a local package dir installed with --no-build-isolation
+(pip treats path requirements natively; option strings pass through).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+
+@pytest.fixture()
+def rt_pip(tmp_path_factory):
+    """Own cluster with a PRIVATE pip cache dir: the env var must be set
+    before init so the raylet/workers inherit it — also keeps the test
+    hermetic (no growth in the node-wide /tmp cache, no cross-process
+    races on the delta assertions)."""
+    cache = str(tmp_path_factory.mktemp("pip_envs"))
+    old = os.environ.get("RAYTPU_PIP_CACHE_DIR")
+    os.environ["RAYTPU_PIP_CACHE_DIR"] = cache
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield ray_tpu, cache
+    finally:
+        ray_tpu.shutdown()
+        if old is None:
+            os.environ.pop("RAYTPU_PIP_CACHE_DIR", None)
+        else:
+            os.environ["RAYTPU_PIP_CACHE_DIR"] = old
+
+
+@pytest.fixture()
+def probe_pkg(tmp_path):
+    """A tiny installable package absent from the base environment."""
+    pkg = tmp_path / "raytpu_pip_probe_pkg"
+    (pkg / "raytpu_pip_probe").mkdir(parents=True)
+    (pkg / "raytpu_pip_probe" / "__init__.py").write_text("VALUE = 42\n")
+    (pkg / "setup.py").write_text(textwrap.dedent("""
+        from setuptools import setup
+        setup(name="raytpu-pip-probe", version="0.1",
+              packages=["raytpu_pip_probe"])
+    """))
+    return str(pkg)
+
+
+def test_pip_env_installs_and_caches(rt_pip, probe_pkg):
+    ray_tpu, cache = rt_pip
+    renv = {"pip": ["--no-build-isolation", probe_pkg]}
+
+    @ray_tpu.remote(runtime_env=renv)
+    def use_probe():
+        import raytpu_pip_probe
+
+        return raytpu_pip_probe.VALUE
+
+    @ray_tpu.remote
+    def plain_import():
+        try:
+            import raytpu_pip_probe  # noqa: F401
+
+            return "importable"
+        except ImportError:
+            return "absent"
+
+    assert ray_tpu.get(use_probe.remote(), timeout=180) == 42
+    # the base env stays clean (the env layers per task, not globally)
+    assert ray_tpu.get(plain_import.remote(), timeout=60) == "absent"
+    # later uses (possibly other workers) reuse the SAME cached env
+    assert ray_tpu.get(use_probe.remote(), timeout=180) == 42
+    assert ray_tpu.get(use_probe.remote(), timeout=180) == 42
+    envs = [d for d in os.listdir(cache) if not d.startswith(".")]
+    assert len(envs) == 1, envs  # one hash -> one cached env for 3 uses
+    assert os.path.exists(os.path.join(cache, envs[0], ".raytpu_ready"))
+
+
+def test_pip_env_hash_ignores_requirement_order(rt_pip, probe_pkg):
+    ray_tpu, cache = rt_pip
+
+    @ray_tpu.remote(
+        runtime_env={"pip": ["--no-build-isolation", probe_pkg]}
+    )
+    def a():
+        import raytpu_pip_probe
+
+        return raytpu_pip_probe.VALUE
+
+    # same requirements, different list order -> same cached env
+    @ray_tpu.remote(
+        runtime_env={"pip": [probe_pkg, "--no-build-isolation"]}
+    )
+    def b():
+        import raytpu_pip_probe
+
+        return raytpu_pip_probe.VALUE
+
+    assert ray_tpu.get(a.remote(), timeout=180) == 42
+    assert ray_tpu.get(b.remote(), timeout=180) == 42
+    envs = [d for d in os.listdir(cache) if not d.startswith(".")]
+    assert len(envs) == 1, envs
+
+
+def test_pip_env_on_actor(rt_pip, probe_pkg):
+    ray_tpu, _cache = rt_pip
+
+    @ray_tpu.remote(runtime_env={
+        "pip": ["--no-build-isolation", probe_pkg],
+        "env_vars": {"PROBE_SUFFIX": "!"},
+    })
+    class Uses:
+        def read(self):
+            import raytpu_pip_probe
+
+            return f"{raytpu_pip_probe.VALUE}{os.environ['PROBE_SUFFIX']}"
+
+    a = Uses.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=180) == "42!"
+
+
+def test_pip_env_failure_surfaces_and_env_vars_restore(rt_pip):
+    ray_tpu, cache = rt_pip
+
+    @ray_tpu.remote(runtime_env={
+        "pip": ["/nonexistent/definitely-nope"],
+        "env_vars": {"PIP_LEAK_PROBE": "leaked"},
+    })
+    def boom():
+        return 1
+
+    @ray_tpu.remote
+    def read_leak():
+        return os.environ.get("PIP_LEAK_PROBE")
+
+    with pytest.raises(Exception, match="pip install failed"):
+        ray_tpu.get(boom.remote(), timeout=180)
+    # the failed env setup must not leak its env_vars into the worker
+    assert ray_tpu.get(read_leak.remote(), timeout=60) is None
+    # and no half-built env dir was blessed into the cache
+    assert [d for d in os.listdir(cache)
+            if not d.startswith(".") and not d.endswith(".lock")] == []
+
+
+def test_pip_env_rejects_bad_spec(rt_pip):
+    ray_tpu, _cache = rt_pip
+
+    with pytest.raises(ValueError, match="pip must be a list"):
+        @ray_tpu.remote(runtime_env={"pip": 42})
+        def bad():
+            return 1
+
+        bad.remote()
