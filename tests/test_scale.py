@@ -79,13 +79,13 @@ def test_10k_objects_single_get(rt_scale):
 
 
 def test_many_actors(rt_scale):
-    """300 concurrent actors on one node. The reference row is 40k+
-    across a 64-node cluster (~600/node); one actor is one worker
-    process here, so this box's bound is process spawn + memory, not
-    the control plane — 300 exercises registration, naming, and the
-    per-actor submit machinery at depth."""
+    """600 concurrent actors on one node — the reference envelope's
+    PER-NODE density (40k+ across a 64-node cluster ~ 600/node). One
+    actor is one worker process, so the cost is process spawn on the
+    1-core box (~6 min measured); registration, naming, and the
+    per-actor submit machinery all run at full depth."""
 
-    @ray_tpu.remote(num_cpus=0.01)
+    @ray_tpu.remote(num_cpus=0.005)
     class Echo:
         def __init__(self, i):
             self.i = i
@@ -93,21 +93,28 @@ def test_many_actors(rt_scale):
         def whoami(self):
             return self.i
 
-    actors = [Echo.remote(i) for i in range(300)]
+    actors = [Echo.remote(i) for i in range(600)]
     out = ray_tpu.get(
         [a.whoami.remote() for a in actors], timeout=1800
     )
-    assert sorted(out) == list(range(300))
+    assert sorted(out) == list(range(600))
     # second wave over warm actors: the per-actor streaming path
     out = ray_tpu.get(
         [a.whoami.remote() for a in actors], timeout=600
     )
-    assert sorted(out) == list(range(300))
+    assert sorted(out) == list(range(600))
 
 
 def test_large_single_object():
     """One ~1.2GiB object through put/get intact (envelope row: 100GiB+);
-    zero-copy read (the returned array views the store, not a copy)."""
+    zero-copy read (the returned array views the store, not a copy).
+
+    Box bound: the 100GiB+ reference row needs that much host RAM for
+    the /dev/shm arena plus the source buffer; this host has ~128GiB of
+    shm but the test also has to coexist with the suite, so 1.28GiB
+    exercises the same chunked-create/seal/zero-copy-read code path the
+    100GiB row uses — the store's mmap arena has no per-object size
+    branch past the inline threshold."""
     ray_tpu.init(num_cpus=2, object_store_memory=1536 * 1024 * 1024)
     try:
         big = np.arange(160_000_000, dtype=np.float64)  # 1.28 GB
