@@ -148,5 +148,9 @@ _d("gcs_storage_backend", str, "memory")
 # death, where the atomic rename alone suffices)
 _d("gcs_snapshot_interval_s", float, 0.5)
 _d("gcs_snapshot_fsync", bool, False)
+# external-storage URI (file:///mnt/nfs/..., bucket://...) mirroring every
+# GCS snapshot: survives a lost head volume (the Redis-tier role of the
+# reference's GCS FT); "" = local snapshots only
+_d("gcs_snapshot_mirror_uri", str, "")
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)

@@ -159,22 +159,64 @@ class GcsServer:
 
     # ---------------- persistence (file backend) ----------------
 
+    def _mirror_storage(self):
+        """External-storage mirror for snapshots (``gcs_snapshot_mirror_
+        uri``): the answer to a LOST HEAD VOLUME, which the local file
+        backend cannot survive. Role parity: the reference's Redis GCS
+        tier (redis_store_client.h:33) — here a replicated-object write
+        to the same pluggable bucket interface spilling uses. The
+        backend is memoized per URI (a bucket client per 0.5s snapshot
+        tick would re-auth constantly)."""
+        uri = GLOBAL_CONFIG.gcs_snapshot_mirror_uri
+        if not uri:
+            return None
+        cached = getattr(self, "_mirror_cache", None)
+        if cached is not None and cached[0] == uri:
+            return cached[1]
+        from ray_tpu._private.external_storage import storage_from_uri
+
+        backend = storage_from_uri(uri)
+        self._mirror_cache = (uri, backend)
+        return backend
+
     def _load_storage(self):
-        if not self.storage_path or not os.path.exists(self.storage_path):
+        if not self.storage_path:
             return
         import pickle
 
-        try:
-            with open(self.storage_path, "rb") as f:
-                snap = pickle.load(f)
-            self.kv = snap.get("kv", {})
-            self.jobs = snap.get("jobs", {})
-            logger.info(
-                "restored GCS tables from %s (%d kv keys, %d jobs)",
-                self.storage_path, len(self.kv), len(self.jobs),
-            )
-        except Exception:
-            logger.exception("failed to load GCS storage; starting empty")
+        snap = None
+        if os.path.exists(self.storage_path):
+            try:
+                with open(self.storage_path, "rb") as f:
+                    snap = pickle.load(f)
+            except Exception:
+                logger.exception("failed to load local GCS snapshot")
+        if snap is None:
+            # local volume gone/corrupt: restore from the mirror
+            try:
+                mirror = self._mirror_storage()
+                if mirror is not None:
+                    data = mirror.get(mirror.uri_for("gcs/snapshot"))
+                    snap = pickle.loads(data)
+                    logger.info("restored GCS tables from mirror %s",
+                                GLOBAL_CONFIG.gcs_snapshot_mirror_uri)
+            except FileNotFoundError:
+                logger.info("no GCS snapshot mirror object; starting empty")
+            except Exception:
+                # a mirror that EXISTS but cannot be read is the failure
+                # the operator must see, not an info line
+                logger.exception(
+                    "GCS snapshot mirror exists but is unreadable; "
+                    "starting empty"
+                )
+        if snap is None:
+            return
+        self.kv = snap.get("kv", {})
+        self.jobs = snap.get("jobs", {})
+        logger.info(
+            "restored GCS tables (%d kv keys, %d jobs)",
+            len(self.kv), len(self.jobs),
+        )
 
     def _mark_dirty(self):
         self._dirty = True
@@ -186,19 +228,18 @@ class GcsServer:
         self._dirty = False
         return {"kv": dict(self.kv), "jobs": dict(self.jobs)}
 
-    def _write_snapshot(self, snap: Dict):
-        """Atomic snapshot write. Durability policy is CONFIGURABLE
+    def _write_snapshot(self, blob: bytes):
+        """Atomic snapshot write (pre-serialized bytes — pickled once,
+        shared with the mirror upload). Durability policy is CONFIGURABLE
         (VERDICT r3 weak #9): ``gcs_snapshot_fsync`` additionally
         fsyncs the data and the directory entry, so a committed snapshot
         survives host power loss — at ~ms write cost. Off by default:
         the file backend's threat model is GCS *process* death (the
         rename is crash-atomic for that), and lost-disk recovery is the
-        bucket/Redis tier's job, not this one's."""
-        import pickle
-
+        mirror/Redis tier's job, not this one's."""
         tmp = self.storage_path + f".tmp.{os.urandom(4).hex()}"
         with open(tmp, "wb") as f:
-            pickle.dump(snap, f, protocol=5)
+            f.write(blob)
             if GLOBAL_CONFIG.gcs_snapshot_fsync:
                 f.flush()
                 os.fsync(f.fileno())
@@ -211,9 +252,26 @@ class GcsServer:
             finally:
                 os.close(dfd)
 
+    def _flush_snapshot(self, snap: Dict):
+        """Local write + mirror upload, called OFF the event loop (the
+        persist loop's executor hop / the shutdown path): a
+        multi-hundred-ms bucket upload on the loop would stall
+        heartbeats/RPCs exactly when FT is enabled."""
+        import pickle
+
+        blob = pickle.dumps(snap, protocol=5)  # serialized ONCE for both
+        self._write_snapshot(blob)
+        try:
+            mirror = self._mirror_storage()
+            if mirror is not None:
+                mirror.put("gcs/snapshot", blob)
+        except Exception:  # incl. an unconstructible backend (bad URI)
+            logger.exception("GCS snapshot mirror write failed "
+                             "(local snapshot intact)")
+
     def _persist_now(self):
         if self.storage_path:
-            self._write_snapshot(self._snapshot())
+            self._flush_snapshot(self._snapshot())
 
     async def _persist_loop(self):
         while True:
@@ -224,7 +282,7 @@ class GcsServer:
                 snap = self._snapshot()  # loop thread: consistent copy
                 try:
                     await asyncio.get_running_loop().run_in_executor(
-                        None, self._write_snapshot, snap
+                        None, self._flush_snapshot, snap
                     )
                 except Exception:
                     logger.exception("GCS persistence flush failed")

@@ -1,0 +1,72 @@
+"""GCS snapshot mirroring to external storage (VERDICT r4 missing #6).
+
+A lost head volume is game over for the file backend alone; with
+``gcs_snapshot_mirror_uri`` every snapshot also lands in the pluggable
+external-storage tier (the reference's Redis-GCS role,
+redis_store_client.h:33), and a fresh GCS with no local snapshot
+restores from it.
+"""
+
+import os
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.gcs import GcsServer
+
+
+def _with_mirror(uri):
+    old = GLOBAL_CONFIG.gcs_snapshot_mirror_uri
+    GLOBAL_CONFIG.gcs_snapshot_mirror_uri = uri
+    return old
+
+
+def test_snapshot_mirrors_and_restores_after_lost_volume(tmp_path):
+    mirror_uri = f"file://{tmp_path}/mirror"
+    local = str(tmp_path / "head_volume" / "gcs.snapshot")
+    os.makedirs(os.path.dirname(local))
+    old = _with_mirror(mirror_uri)
+    try:
+        g = GcsServer(str(tmp_path / "gcs.sock"), storage_path=local)
+        g.kv = {"flag": b"v1", "other": b"v2"}
+        g.jobs = {b"j1": {"status": "SUCCEEDED"}}
+        g._persist_now()
+        assert os.path.exists(local)
+
+        # head volume dies entirely
+        os.unlink(local)
+        os.rmdir(os.path.dirname(local))
+
+        g2 = GcsServer(str(tmp_path / "gcs2.sock"), storage_path=local)
+        g2._load_storage()
+        assert g2.kv == {"flag": b"v1", "other": b"v2"}
+        assert g2.jobs == {b"j1": {"status": "SUCCEEDED"}}
+    finally:
+        _with_mirror(old)
+
+
+def test_mirror_failure_keeps_local_snapshot(tmp_path):
+    old = _with_mirror("file:///proc/definitely/not/writable")
+    local = str(tmp_path / "gcs.snapshot")
+    try:
+        g = GcsServer(str(tmp_path / "gcs.sock"), storage_path=local)
+        g.kv = {"k": b"v"}
+        g._persist_now()  # mirror write fails; must not raise
+        assert os.path.exists(local)
+        g2 = GcsServer(str(tmp_path / "gcs2.sock"), storage_path=local)
+        g2._load_storage()
+        assert g2.kv == {"k": b"v"}
+    finally:
+        _with_mirror(old)
+
+
+def test_no_mirror_configured_is_noop(tmp_path):
+    old = _with_mirror("")
+    local = str(tmp_path / "gcs.snapshot")
+    try:
+        g = GcsServer(str(tmp_path / "gcs.sock"), storage_path=local)
+        g.kv = {"k": b"v"}
+        g._persist_now()
+        g2 = GcsServer(str(tmp_path / "gcs2.sock"), storage_path=local)
+        g2._load_storage()
+        assert g2.kv == {"k": b"v"}
+    finally:
+        _with_mirror(old)

@@ -236,7 +236,11 @@ def test_gcs_snapshot_fsync_policy(tmp_path, monkeypatch):
     srv._dirty = True
     srv.kv = {b"k": b"v"}
     srv.jobs = {"j1": {"status": "SUCCEEDED"}}
-    srv._write_snapshot({"kv": srv.kv, "jobs": srv.jobs})
+    import pickle
+
+    srv._write_snapshot(
+        pickle.dumps({"kv": srv.kv, "jobs": srv.jobs}, protocol=5)
+    )
     srv2 = GcsServer.__new__(GcsServer)
     srv2.storage_path = path
     srv2.kv = {}
