@@ -1406,6 +1406,16 @@ class CoreWorker:
                         timeout=None,
                     )
                 except Exception:
+                    if self._shutdown.is_set() or self.raylet.conn.closed:
+                        # teardown (or a dead raylet): fail the queue
+                        # instead of resubmitting — the finally's re-kick
+                        # would otherwise spin lease loops against a
+                        # closed conn forever and wedge shutdown
+                        while st.queue:
+                            self._fail_task(st.queue.popleft(), exc.
+                                            WorkerCrashedError(
+                                "cluster shutting down / raylet gone"
+                            ))
                     return
                 if reply.get("granted"):
                     grant = reply
@@ -1433,7 +1443,7 @@ class CoreWorker:
         finally:
             if not granted:
                 st.requests_in_flight -= 1
-                if st.queue:
+                if st.queue and not self._shutdown.is_set():
                     self._maybe_request_lease(key, st)
 
     def _plasma_arg_wire(self, spec: TaskSpec) -> List:
