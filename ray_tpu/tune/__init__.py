@@ -29,6 +29,7 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
@@ -53,7 +54,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining",
+    "PopulationBasedTraining", "PB2",
     "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearcher",
     "report",
 ]

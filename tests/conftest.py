@@ -56,3 +56,13 @@ def rt():
     ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_tune():
+    """Shared tune-suite cluster (4 CPUs, small store)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()

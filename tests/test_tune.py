@@ -10,13 +10,6 @@ import ray_tpu
 from ray_tpu import tune
 
 
-@pytest.fixture
-def rt_tune():
-    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
-    yield ray_tpu
-    ray_tpu.shutdown()
-
-
 def test_variant_generation():
     from ray_tpu.tune.search import generate_variants
 
