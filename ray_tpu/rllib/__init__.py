@@ -10,6 +10,7 @@ Parity: reference ``rllib/algorithms/ppo/``; sampling plane =
 actor-critic update (ppo.py).
 """
 
+from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.learner_group import LearnerGroup  # noqa: F401
@@ -28,6 +29,6 @@ from ray_tpu.rllib.offline import (  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+__all__ = ["APPO", "APPOConfig", "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "BC", "BCConfig", "MultiAgentEnv", "MultiAgentPPO",
            "MultiAgentPPOConfig", "LearnerGroup"]

@@ -62,35 +62,48 @@ def vtrace(
     return vs, pg_adv
 
 
+def forward_vtrace(params, batch, config):
+    """Shared forward + V-trace block for the IMPALA-family losses
+    (IMPALA's plain pg, APPO's clipped surrogate — appo.py): returns
+    (target_logp, logp_all, values, vs, pg_adv) over [B, T]."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    B, T = batch["actions"].shape
+    obs = batch["obs"].reshape(B * T, -1)
+    logits, values = apply_actor_critic(params, obs)
+    logits = logits.reshape(B, T, -1)
+    values = values.reshape(B, T)
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None], axis=-1
+    )[..., 0]
+    vs, pg_adv = jax.lax.stop_gradient(
+        jax.vmap(
+            lambda blp, tlp, r, v, nv, t, cu: vtrace(
+                blp, tlp, r, v, nv, t, cu,
+                c.gamma, c.rho_bar, c.c_bar,
+            )
+        )(
+            batch["logp"], target_logp, batch["rewards"], values,
+            batch["next_values"], batch["terminals"], batch["cuts"],
+        )
+    )
+    return target_logp, logp_all, values, vs, pg_adv
+
+
 def make_impala_loss(config: "IMPALAConfig"):
     """Batched IMPALA loss over [B, T] rollouts: V-trace vmapped over the
     trajectory axis, means over B*T — the leading axis is shardable, so
     the SAME loss runs dp=1 or dp-sharded across a LearnerGroup."""
-    import jax
     import jax.numpy as jnp
 
     c = config
 
     def loss_fn(params, batch):
-        B, T = batch["actions"].shape
-        obs = batch["obs"].reshape(B * T, -1)
-        logits, values = apply_actor_critic(params, obs)
-        logits = logits.reshape(B, T, -1)
-        values = values.reshape(B, T)
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, batch["actions"][..., None], axis=-1
-        )[..., 0]
-        vs, pg_adv = jax.lax.stop_gradient(
-            jax.vmap(
-                lambda blp, tlp, r, v, nv, t, cu: vtrace(
-                    blp, tlp, r, v, nv, t, cu,
-                    c.gamma, c.rho_bar, c.c_bar,
-                )
-            )(
-                batch["logp"], target_logp, batch["rewards"], values,
-                batch["next_values"], batch["terminals"], batch["cuts"],
-            )
+        target_logp, logp_all, values, vs, pg_adv = forward_vtrace(
+            params, batch, c
         )
         pg = -(target_logp * pg_adv).mean()
         vf = ((values - vs) ** 2).mean()
@@ -145,7 +158,7 @@ class IMPALA:
             jax.random.key(config.seed), obs_dim, num_actions, config.hidden
         )
         self.learners = LearnerGroup(
-            make_impala_loss(config), params, optax.adam(config.lr),
+            self._make_loss(), params, optax.adam(config.lr),
             num_learners=config.num_learners,
         )
         self.workers = make_rollout_workers(
@@ -162,6 +175,14 @@ class IMPALA:
         self.num_env_steps = 0
         self._recent: List[float] = []
         self.last_loss = float("nan")
+
+    def _make_loss(self):
+        """Loss factory hook (APPO overrides with the clipped surrogate)."""
+        return make_impala_loss(self.config)
+
+    def _update(self, batch: Dict[str, np.ndarray]) -> float:
+        """One consumed group -> learner update(s); APPO loops epochs."""
+        return self.learners.update(batch)
 
     def _stack(self, rollouts: List[Dict]) -> Dict[str, np.ndarray]:
         keys = ("obs", "actions", "logp", "rewards", "next_values",
@@ -207,7 +228,7 @@ class IMPALA:
                 self._recent.extend(rollout["episode_returns"].tolist())
                 self.num_env_steps += len(rollout["actions"])
             self._recent = self._recent[-100:]
-            self.last_loss = self.learners.update(self._stack(got))
+            self.last_loss = self._update(self._stack(got))
             self.num_async_updates += 1
             # refresh ONLY the consumed workers, resubmit them (async)
             params_ref = ray_tpu.put(self.learners.get_params_host())

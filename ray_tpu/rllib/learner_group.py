@@ -68,9 +68,13 @@ class LearnerGroup:
             donate_argnums=(0, 1),
         )
 
-    def update(self, batch: Dict[str, np.ndarray]) -> float:
-        """One dp-sharded SGD step on a batch whose leading axis is
-        divisible by num_learners. Returns the (global) loss."""
+    def update(self, batch: Dict[str, np.ndarray],
+               epochs: int = 1) -> float:
+        """``epochs`` dp-sharded SGD steps on a batch whose leading axis
+        is divisible by num_learners. The batch crosses host->device
+        ONCE and the loss syncs once (multi-epoch consumers like APPO
+        would otherwise pay a transfer + blocking float() per epoch).
+        Returns the (global) loss of the final epoch."""
         jax = self._jax
         lead = next(iter(batch.values())).shape[0]
         if lead % self.num_learners:
@@ -79,9 +83,10 @@ class LearnerGroup:
                 f"num_learners={self.num_learners}"
             )
         dev_batch = jax.device_put(batch, self._batch_sh)
-        self.params, self.opt_state, loss = self._update(
-            self.params, self.opt_state, dev_batch
-        )
+        for _ in range(max(1, epochs)):
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, dev_batch
+            )
         return float(loss)
 
     def get_params_host(self):
