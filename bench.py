@@ -172,25 +172,35 @@ def main():
             _probe_out.put(e)
 
     _threading.Thread(target=_probe, daemon=True).start()
+    accel_unreachable = False
+    _devices = None
     try:
         _devices = _probe_out.get(timeout=float(
             os.environ.get("RAYTPU_BENCH_DEVICE_TIMEOUT_S", "180")
         ))
     except _queue.Empty:
-        print(json.dumps({
-            "metric": "train_step_mfu", "value": 0.0,
-            "unit": "mfu_fraction", "vs_baseline": 0.0,
-            "detail": {"error": "accelerator backend unreachable "
-                                "(device probe timed out)"},
-        }))
-        return 1
+        # Infra failure, not a perf regression: the device-independent
+        # micro/data sections below still run and record (marked
+        # ``accelerator: unreachable``), and the rc distinguishes this
+        # (2) from a floor violation (1).
+        accel_unreachable = True
     if isinstance(_devices, Exception):
         raise _devices
-    dev = _devices[0]
-    on_accel = dev.platform != "cpu"
-    mesh = build_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
-    opt = default_optimizer()
-    peak = peak_flops(dev)
+    if accel_unreachable:
+        # The wedged probe thread may hold jax's backend-init lock until
+        # process exit: no further driver-side jax. Cluster daemons and
+        # workers get an explicit CPU pin so they never re-probe the dead
+        # tunnel themselves.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        dev = None
+        on_accel = False
+        mesh = opt = peak = None
+    else:
+        dev = _devices[0]
+        on_accel = dev.platform != "cpu"
+        mesh = build_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
+        opt = default_optimizer()
+        peak = peak_flops(dev)
 
     def measure(cfg, batch, seq, iters):
         state, state_sh = make_sharded_state(cfg, mesh, opt, jax.random.key(0))
@@ -393,6 +403,11 @@ def main():
         gc.collect()
         time.sleep(3.0)
         metric = "train_step_mfu_400m"
+    elif accel_unreachable:
+        cfg = None
+        dt, mfu, tps = 0.0, 0.0, 0.0
+        long_ctx = inference = serving = None
+        metric = "train_step_mfu"
     else:
         cfg = TransformerConfig.tiny()
         dt, mfu, tps = measure(cfg, batch=4, seq=128, iters=3)
@@ -423,10 +438,15 @@ def main():
                 pipelined_n=8000, batch=100,
             )
             micro["data_ingest"] = run_data_ingest_bench()
-            try:
-                micro["rl"] = run_rl_bench()
-            except Exception as e:  # keep the measured micro numbers
-                micro["rl"] = {"error": str(e)[:160]}
+            if accel_unreachable:
+                # the RL learner uses driver-side jax, which the wedged
+                # probe thread may deadlock — everything above is numpy
+                micro["rl"] = {"skipped": "accelerator unreachable"}
+            else:
+                try:
+                    micro["rl"] = run_rl_bench()
+                except Exception as e:  # keep the measured micro numbers
+                    micro["rl"] = {"error": str(e)[:160]}
         finally:
             ray_tpu.shutdown()
     except Exception as e:  # the MFU headline must survive a micro failure
@@ -473,11 +493,15 @@ def main():
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.40, 4),
         "detail": {
-            "device": getattr(dev, "device_kind", dev.platform),
-            "params": cfg.param_count(),
+            "device": (
+                getattr(dev, "device_kind", dev.platform)
+                if dev is not None else None
+            ),
+            "accelerator": "unreachable" if accel_unreachable else "ok",
+            "params": cfg.param_count() if cfg is not None else None,
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(tps, 1),
-            "attn_impl": cfg.attn_impl,
+            "attn_impl": cfg.attn_impl if cfg is not None else None,
             "long_ctx": long_ctx,
             "inference": inference,
             "serving": serving,
@@ -486,6 +510,12 @@ def main():
         },
     }
     print(json.dumps(out))
+    if accel_unreachable:
+        # rc 2 = infra failure (device probe timed out) — distinct from
+        # rc 1 (a measured perf-floor violation)
+        print("ACCELERATOR UNREACHABLE: device probe timed out; "
+              "device-independent sections recorded above", file=sys.stderr)
+        return 2
     if violations:
         print(f"PERF FLOOR VIOLATIONS: {violations}", file=sys.stderr)
         return 1

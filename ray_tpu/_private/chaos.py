@@ -1,0 +1,256 @@
+"""Network chaos plane: deterministic, seeded message-level fault injection.
+
+Parity: the role of the reference's nightly chaos suite's network faults
+(its ``NodeKillerActor`` covers only process death; real deployments die
+on the *network* paths — dropped replies, delayed heartbeats, partitions,
+a GCS that vanishes mid-run).  This plane injects those faults INSIDE the
+wire layer (``rpc.Connection`` send paths and the conduit transport's
+``send_frame``) so every retry/reconnect/idempotent-replay path runs
+against hostile links without touching application code.
+
+Determinism: every probabilistic decision is a pure function of
+``(seed, rule_index, link, per-link frame seq)`` via a keyed blake2b
+hash — replaying a workload with the same seed injects the *identical*
+fault schedule for the same (link, seq) pairs, and
+:meth:`ChaosPlane.schedule` enumerates that schedule byte-identically
+without running any workload at all.  Time-windowed faults (partitions,
+blackouts) use a wall-clock ``epoch`` shared across processes via the
+spec, so one JSON document drives every process in the cluster.
+
+Spec (JSON in the ``RAYTPU_CHAOS_SPEC`` env var — inherited by every
+daemon/worker the cluster spawns):
+
+    {
+      "seed": 42,
+      "epoch": 1722700000.0,          # time.time() base for windows
+      "rules": [                       # first match wins
+        {"link": "gcs",               # substring of the link id ("*" = any)
+         "role": "*",                 # substring of this process's role
+         "drop": 0.05,                # P(frame dropped)
+         "dup": 0.02,                 # P(frame delivered twice)
+         "delay_ms": [10, 50],        # uniform extra latency per frame
+         "reorder": 0.0,              # P(extra delay -> frame overtaken)
+         "reorder_ms": 100}
+      ],
+      "partitions": [                  # bidirectional windowed blackholes
+        {"a": "raylet", "b": "gcs", "start": 5.0, "end": 7.0}
+      ],
+      "blackouts": [                   # one endpoint unreachable
+        {"target": "gcs", "start": 10.0, "end": 12.0}
+      ]
+    }
+
+Semantics note (documented in DESIGN.md): drop/dup/reorder model
+message-level faults.  The GCS control plane is built for them
+(at-least-once transport + request-id dedup = effectively-once apply).
+The streamed task data plane assumes an ordered reliable byte stream
+(TCP/unix) per connection and recovers from *connection* death via task
+retry + lineage — point chaos rules at ``gcs`` links for message chaos,
+and use :class:`~ray_tpu._private.test_utils.ChaosKiller` for
+process-death chaos on the data plane.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ENV_SPEC = "RAYTPU_CHAOS_SPEC"
+
+# The active per-process plane. Read directly (``chaos._PLANE``) on the
+# wire hot path: one module-attr load + None check when chaos is off.
+_PLANE: Optional["ChaosPlane"] = None
+
+
+class ChaosRule:
+    """One probabilistic or windowed fault rule."""
+
+    __slots__ = ("link", "role", "drop", "dup", "reorder", "reorder_ms",
+                 "delay_lo", "delay_hi", "start", "end")
+
+    def __init__(self, d: Dict):
+        self.link = str(d.get("link", "*"))
+        self.role = str(d.get("role", "*"))
+        self.drop = float(d.get("drop", 0.0))
+        self.dup = float(d.get("dup", 0.0))
+        self.reorder = float(d.get("reorder", 0.0))
+        self.reorder_ms = float(d.get("reorder_ms", 100.0))
+        lo, hi = (d.get("delay_ms") or (0.0, 0.0))
+        self.delay_lo, self.delay_hi = float(lo), float(hi)
+        self.start = float(d.get("start") or 0.0)
+        # absent/None end = open-ended window
+        end = d.get("end")
+        self.end = float(end) if end is not None else float("inf")
+
+
+class ChaosPlane:
+    """Per-process fault-injection decision engine (stateless per frame:
+    all state a decision needs is the per-connection frame counter its
+    caller owns)."""
+
+    def __init__(self, spec: Dict, role: str = ""):
+        self.spec = spec
+        self.seed = int(spec.get("seed", 0))
+        self.epoch = float(spec.get("epoch") or time.time())
+        self.role = role
+        self.rules: List[ChaosRule] = [
+            ChaosRule(r) for r in (spec.get("rules") or [])
+        ]
+        # Partitions/blackouts normalize to windowed drop-all rules.
+        self.window_rules: List[ChaosRule] = []
+        for p in spec.get("partitions") or []:
+            w = {"start": p.get("start", 0.0), "end": p.get("end")}
+            self.window_rules.append(
+                ChaosRule(dict(w, role=p["a"], link=p["b"]))
+            )
+            self.window_rules.append(
+                ChaosRule(dict(w, role=p["b"], link=p["a"]))
+            )
+        for b in spec.get("blackouts") or []:
+            w = {"start": b.get("start", 0.0), "end": b.get("end")}
+            tgt = b["target"]
+            # frames TO the target (link matches) and FROM it (role matches)
+            self.window_rules.append(ChaosRule(dict(w, link=tgt)))
+            self.window_rules.append(ChaosRule(dict(w, role=tgt)))
+        self.stats = collections.Counter()
+
+    # ---------------- matching ----------------
+    def _matches(self, rule: ChaosRule, link: str) -> bool:
+        if rule.link != "*" and rule.link not in link:
+            return False
+        if rule.role != "*" and rule.role not in self.role:
+            return False
+        return True
+
+    # ---------------- deterministic decisions ----------------
+    def _uniforms(self, rule_idx: int, link: str, seq: int):
+        h = hashlib.blake2b(
+            f"{rule_idx}|{link}|{seq}".encode(),
+            digest_size=16,
+            key=self.seed.to_bytes(8, "big", signed=True),
+        ).digest()
+        return tuple(
+            int.from_bytes(h[i * 4:(i + 1) * 4], "big") / 2**32
+            for i in range(4)
+        )
+
+    def _decide_prob(self, link: str, seq: int) -> Tuple[int, float]:
+        """Pure probabilistic decision: (copies, delay_s).  copies 0 =
+        drop, 1 = deliver, 2 = duplicate.  A pure function of
+        (seed, link, seq) — the replayable schedule."""
+        for i, rule in enumerate(self.rules):
+            if not self._matches(rule, link):
+                continue
+            u_drop, u_dup, u_reorder, u_delay = self._uniforms(i, link, seq)
+            if u_drop < rule.drop:
+                return (0, 0.0)
+            delay = (
+                rule.delay_lo + u_delay * (rule.delay_hi - rule.delay_lo)
+            ) / 1e3
+            if u_reorder < rule.reorder:
+                delay += rule.reorder_ms / 1e3
+            return ((2 if u_dup < rule.dup else 1), delay)
+        return (1, 0.0)
+
+    def decide(self, link: str, seq: int,
+               now: Optional[float] = None) -> Tuple[int, float]:
+        """Full decision for one outbound frame: windowed faults
+        (partitions/blackouts, wall-clock-gated) first, then the seeded
+        probabilistic schedule."""
+        t = (time.time() if now is None else now) - self.epoch
+        for rule in self.window_rules:
+            if rule.start <= t < rule.end and self._matches(rule, link):
+                self.stats["window_dropped"] += 1
+                return (0, 0.0)
+        copies, delay = self._decide_prob(link, seq)
+        if copies == 0:
+            self.stats["dropped"] += 1
+        elif copies > 1:
+            self.stats["duplicated"] += 1
+        if delay > 0:
+            self.stats["delayed"] += 1
+        self.stats["frames"] += 1
+        return (copies, delay)
+
+    # ---------------- replay/verification API ----------------
+    def schedule(self, links: Sequence[str], n: int) -> List[Tuple]:
+        """Enumerate the deterministic fault schedule for the first ``n``
+        frames of each link: [(link, seq, copies, delay_us), ...].
+        Byte-identical across runs/processes for the same seed."""
+        out = []
+        for link in links:
+            for seq in range(n):
+                copies, delay = self._decide_prob(link, seq)
+                out.append((link, seq, copies, int(round(delay * 1e6))))
+        return out
+
+    def schedule_digest(self, links: Sequence[str], n: int) -> str:
+        return hashlib.sha256(
+            repr(self.schedule(links, n)).encode()
+        ).hexdigest()
+
+
+def make_spec(
+    seed: int = 0,
+    *,
+    drop: float = 0.0,
+    dup: float = 0.0,
+    delay_ms: Tuple[float, float] = (0.0, 0.0),
+    reorder: float = 0.0,
+    link: str = "*",
+    rules: Optional[List[Dict]] = None,
+    partitions: Optional[List[Dict]] = None,
+    blackouts: Optional[List[Dict]] = None,
+    epoch: Optional[float] = None,
+) -> Dict:
+    """Build a chaos spec dict. ``rules`` overrides the single-rule
+    shorthand (drop/dup/delay_ms/reorder/link)."""
+    if rules is None:
+        rules = [{
+            "link": link, "drop": drop, "dup": dup,
+            "delay_ms": list(delay_ms), "reorder": reorder,
+        }]
+    return {
+        "seed": int(seed),
+        "epoch": float(epoch if epoch is not None else time.time()),
+        "rules": rules,
+        "partitions": partitions or [],
+        "blackouts": blackouts or [],
+    }
+
+
+def install(spec: Dict, role: str = "") -> "ChaosPlane":
+    """Activate a plane in THIS process (tests/drivers)."""
+    global _PLANE
+    _PLANE = ChaosPlane(spec, role=role)
+    return _PLANE
+
+
+def install_from_env(role: str = "") -> Optional["ChaosPlane"]:
+    """Activate from ``RAYTPU_CHAOS_SPEC`` if set (daemon/worker mains
+    call this at startup so a driver-exported spec drives the whole
+    cluster). No-op (and deactivates) when the env var is absent."""
+    global _PLANE
+    raw = os.environ.get(ENV_SPEC)
+    if not raw:
+        _PLANE = None
+        return None
+    try:
+        _PLANE = ChaosPlane(json.loads(raw), role=role)
+    except Exception:
+        _PLANE = None
+        return None
+    return _PLANE
+
+
+def uninstall():
+    global _PLANE
+    _PLANE = None
+
+
+def plane() -> Optional["ChaosPlane"]:
+    return _PLANE

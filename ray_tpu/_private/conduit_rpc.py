@@ -24,11 +24,11 @@ import collections
 import itertools
 import threading
 import time
-import traceback
 from typing import Callable, Dict, List, Optional
 
 import msgpack
 
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import conduit, rpc
 
 
@@ -131,14 +131,46 @@ class ConduitConnection:
         # batched task_done completions (see task_done_fn)
         self._done_lock = threading.Lock()
         self._done_buf: List = []
+        # chaos-plane link identity (see rpc.Connection.chaos_peer)
+        self.chaos_peer = ""
+        self._chaos_seq = itertools.count()  # thread-safe enough (GIL)
 
     # ---- outbound (any thread) ----
-    def send_frame(self, kind, seqno, method, data):
-        body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
+    def send_frame(self, kind, seqno, method, data, rid=None):
+        msg = [kind, seqno, method, data]
+        if rid is not None:
+            msg.append(rid)
+        body = msgpack.packb(msg, use_bin_type=True)
+        pl = _chaos._PLANE
+        if pl is not None:
+            link = self.name + (
+                "|" + self.chaos_peer if self.chaos_peer else ""
+            )
+            copies, delay = pl.decide(link, next(self._chaos_seq))
+            if copies == 0:
+                return
+            if delay > 0:
+                # chaos-mode only: a timer thread per delayed frame is
+                # fine at test rates and works from any calling thread
+                t = threading.Timer(
+                    delay, self._send_raw, args=(body, copies)
+                )
+                t.daemon = True
+                t.start()
+                return
+            if copies > 1:
+                self._send_raw(body, copies - 1)
         try:
             self.engine.send(self.conn_id, body)
         except ConnectionError as e:
             raise rpc.SendError(str(e)) from e
+
+    def _send_raw(self, body: bytes, copies: int):
+        for _ in range(copies):
+            try:
+                self.engine.send(self.conn_id, body)
+            except ConnectionError:
+                return  # conn died while the frame was "in flight"
 
     def reply_fn(self, seqno, method) -> Callable[[dict], None]:
         """Thread-safe completion callback: the exec thread replies
@@ -194,14 +226,14 @@ class ConduitConnection:
             pass
 
     # ---- rpc.Connection surface ----
-    async def call_async(self, method, data, timeout=None):
+    async def call_async(self, method, data, timeout=None, rid=None):
         seqno = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
         try:
             if self._closed:
                 raise rpc.SendError(f"connection {self.name} closed")
-            self.send_frame(rpc._REQUEST, seqno, method, data)
+            self.send_frame(rpc._REQUEST, seqno, method, data, rid)
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
@@ -242,7 +274,9 @@ class ConduitConnection:
 
     # ---- inbound (reaper thread) ----
     def on_frame(self, payload: bytes):
-        kind, seqno, method, data = msgpack.unpackb(payload, raw=False)
+        msg = msgpack.unpackb(payload, raw=False)
+        kind, seqno, method, data = msg[0], msg[1], msg[2], msg[3]
+        rid = msg[4] if len(msg) > 4 else None
         if kind in (rpc._REPLY, rpc._ERROR):
             self.loop.call_soon_threadsafe(self._resolve, kind, seqno, data)
             return
@@ -250,7 +284,7 @@ class ConduitConnection:
         if fast is not None and fast(self, kind, seqno, method, data):
             return
         self.loop.call_soon_threadsafe(
-            self._spawn_handler, kind, seqno, method, data
+            self._spawn_handler, kind, seqno, method, data, rid
         )
 
     def _resolve(self, kind, seqno, data):
@@ -261,26 +295,23 @@ class ConduitConnection:
             else:
                 fut.set_exception(rpc.RpcError(data))
 
-    def _spawn_handler(self, kind, seqno, method, data):
-        self.loop.create_task(self._handle(kind, seqno, method, data))
+    def _spawn_handler(self, kind, seqno, method, data, rid=None):
+        self.loop.create_task(self._handle(kind, seqno, method, data, rid))
 
-    async def _handle(self, kind, seqno, method, data):
-        try:
-            t0 = time.monotonic()
-            reply = await self.server.handler(self, method, data)
+    async def _handle(self, kind, seqno, method, data, rid=None):
+        t0 = time.monotonic()
+        out_kind, payload = await rpc.run_idempotent(
+            rid, lambda: self.server.handler(self, method, data)
+        )
+        if out_kind == rpc._REPLY:
             rpc.method_stats().record(
                 method, (time.monotonic() - t0) * 1e3
             )
-            if kind == rpc._REQUEST:
-                self.send_frame(rpc._REPLY, seqno, method, reply)
-        except Exception:
-            if kind == rpc._REQUEST:
-                try:
-                    self.send_frame(
-                        rpc._ERROR, seqno, method, traceback.format_exc()
-                    )
-                except Exception:
-                    pass
+        if kind == rpc._REQUEST:
+            try:
+                self.send_frame(out_kind, seqno, method, payload)
+            except Exception:
+                pass
 
     def on_engine_close(self):
         self._closed = True

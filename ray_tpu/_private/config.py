@@ -152,5 +152,21 @@ _d("gcs_snapshot_fsync", bool, False)
 # GCS snapshot: survives a lost head volume (the Redis-tier role of the
 # reference's GCS FT); "" = local snapshots only
 _d("gcs_snapshot_mirror_uri", str, "")
+# --- delivery semantics / chaos survival ---
+# sync rpc.Client replay: per-attempt timeout CAP (a dropped frame costs
+# one attempt, not the caller's whole budget; slow handlers are safe —
+# retries join the in-flight attempt via server dedup) and the total
+# at-least-once retry window (wide enough to ride a GCS restart/
+# partition/blackout; bounded so a permanently-dead server still
+# errors). Server-side request-id dedup makes the replay
+# effectively-once.
+_d("client_call_attempt_timeout_s", float, 5.0)
+_d("client_retry_window_s", float, 20.0)
+# fsync the GCS mutation journal per append (SIGKILL survival needs only
+# the write() -> page cache; fsync buys power-loss durability at ~ms/op)
+_d("gcs_journal_fsync", bool, False)
+# after a journal-restored GCS boots, how long raylets get to re-register
+# and reclaim their live actors before unclaimed ones are re-placed
+_d("gcs_actor_recovery_grace_s", float, 10.0)
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)

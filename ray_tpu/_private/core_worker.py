@@ -485,22 +485,24 @@ class CoreWorker:
                 os._exit(1)
 
             self.raylet.conn.on_close = _raylet_gone
+        # pubsub channels this worker holds with the GCS: replayed whole on
+        # reconnect (a restarted GCS loses its subscriber registry)
+        self._gcs_channels: set = set()
+
+        def _resub(client):
+            # direct conn call — call() would re-enter the reconnect lock
+            if self._gcs_channels:
+                client.io.run(client.conn.call_async(
+                    "subscribe", sorted(self._gcs_channels), timeout=10
+                ))
+
+        self.gcs.on_reconnect = _resub
         if mode == MODE_DRIVER and GLOBAL_CONFIG.log_to_driver:
             # Receive worker stdout/stderr lines (log monitor pipeline).
             try:
-                self.gcs.call("subscribe", ["logs"])
+                self.gcs_subscribe(["logs"])
             except Exception:
                 pass
-
-            # a restarted GCS loses its subscriber registry: replay on
-            # reconnect (direct conn call — call() would re-enter the
-            # reconnect lock)
-            def _resub(client):
-                client.io.run(
-                    client.conn.call_async("subscribe", ["logs"], timeout=10)
-                )
-
-            self.gcs.on_reconnect = _resub
         if GLOBAL_CONFIG.task_events_enabled:
             async def _event_flusher():
                 while not self._shutdown.is_set():
@@ -560,6 +562,17 @@ class CoreWorker:
         except Exception as e:
             logger.debug("borrow %s notify failed for %s: %s",
                          "add" if add else "remove", ref.hex()[:12], e)
+
+    def gcs_subscribe(self, channels):
+        """Subscribe to GCS pubsub channels, remembered so the client's
+        on_reconnect hook can replay the whole subscription set into a
+        restarted GCS (whose subscriber registry died with it).
+        ``dedup=False``: subscribe is connection-affine — a retry landing
+        on a fresh conn must RE-RUN the handler (registering that conn),
+        not be answered from the request-id reply cache."""
+        snap = self.gcs.call("subscribe", list(channels), dedup=False)
+        self._gcs_channels.update(channels)
+        return snap
 
     async def rpc_publish(self, conn, data):
         """GCS pubsub push. Drivers print forwarded worker log lines

@@ -78,6 +78,20 @@ class PgRecord:
             "assignment": self.assignment,
         }
 
+    # journal/snapshot round-trip (same shape as the wire form)
+    to_state = to_wire
+
+    @classmethod
+    def from_state(cls, d: Dict) -> "PgRecord":
+        rec = cls(bytes(d["pg_id"]), [dict(b) for b in d["bundles"]],
+                  d["strategy"], name=d.get("name") or "")
+        rec.state = d["state"]
+        rec.assignment = [
+            bytes(a) if a is not None else None
+            for a in (d.get("assignment") or [None] * len(rec.bundles))
+        ]
+        return rec
+
 
 class ActorRecord:
     __slots__ = (
@@ -107,6 +121,107 @@ class ActorRecord:
             "method_meta": self.spec.get("method_meta") or {},
             "max_concurrency": self.spec.get("max_concurrency", 1),
         }
+
+    def to_state(self) -> Dict:
+        """Full durable state (journal/snapshot): unlike ``to_wire`` this
+        carries the creation spec, so a restarted GCS can re-place."""
+        return {
+            "actor_id": self.actor_id,
+            "spec": self.spec,
+            "state": self.state,
+            "address": self.address,
+            "num_restarts": self.num_restarts,
+            "restarts_left": self.restarts_left,
+            "name": self.name,
+            "death_cause": self.death_cause,
+        }
+
+    @classmethod
+    def from_state(cls, d: Dict) -> "ActorRecord":
+        rec = cls(bytes(d["actor_id"]), d["spec"], name=d.get("name") or "")
+        rec.state = d["state"]
+        rec.address = d.get("address")
+        rec.num_restarts = int(d.get("num_restarts", 0))
+        rec.restarts_left = int(d.get("restarts_left", 0))
+        rec.death_cause = d.get("death_cause") or ""
+        return rec
+
+
+class GcsJournal:
+    """Append-only mutation log: the file backend's answer to a LIVE GCS
+    SIGKILL with NO snapshot-flush window (role parity: the reference's
+    Redis store client, redis_store_client.h:33 — every mutation is
+    durable at ack time, not at the next snapshot tick).
+
+    Every mutating RPC appends one full-value record BEFORE its reply is
+    sent; ``write()+flush()`` lands the bytes in the OS page cache, which
+    survives process death (``gcs_journal_fsync`` additionally buys
+    power-loss durability). Restore = snapshot + ``.old`` journal (if a
+    rotation's snapshot never landed) + current journal, in order —
+    records are absolute values, so replay is idempotent and a torn tail
+    (killed mid-append) is simply ignored.
+
+    Frame format: [u32 len][msgpack record].
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._f = open(path, "ab")
+        self.appended = 0
+
+    def append(self, rec) -> None:
+        body = rpc.msgpack.packb(rec, use_bin_type=True)
+        self._f.write(len(body).to_bytes(4, "big") + body)
+        self._f.flush()  # into the page cache: survives SIGKILL
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self.appended += 1
+
+    def rotate(self) -> str:
+        """Move the current log aside (journal.old) and start fresh; the
+        caller snapshots the tables in the same event-loop tick, so the
+        ``.old`` file is exactly the delta the pending snapshot covers.
+        Must only be called when no ``.old`` exists (i.e. the previous
+        snapshot landed) — otherwise un-snapshotted records would be
+        overwritten."""
+        self._f.close()
+        old = self.path + ".old"
+        os.replace(self.path, old)
+        self._f = open(self.path, "ab")
+        return old
+
+    def reset(self) -> None:
+        """Truncate (state fully captured by a just-written snapshot)."""
+        self._f.close()
+        self._f = open(self.path, "wb")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def replay(path: str):
+        """Yield records until EOF or the first torn/corrupt frame."""
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return
+        with f:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return
+                n = int.from_bytes(hdr, "big")
+                body = f.read(n)
+                if len(body) < n:
+                    return
+                try:
+                    yield rpc.msgpack.unpackb(body, raw=False)
+                except Exception:
+                    return
 
 
 class GcsServer:
@@ -138,15 +253,38 @@ class GcsServer:
         self._raylet_clients: Dict[bytes, rpc.Connection] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._started = asyncio.Event()
+        # mutation journal (file backend only): effectively the WAL of the
+        # tables; see GcsJournal. ``_recovering`` holds journal-restored
+        # actors awaiting their raylet's restore_actors replay.
+        self._journal_w: Optional[GcsJournal] = None
+        self._journal_rotated_old: Optional[str] = None
+        self._recovering: Set[bytes] = set()
 
     # ---------------- lifecycle ----------------
     async def start(self):
         self._load_storage()
+        if self.storage_path:
+            self._journal_w = GcsJournal(
+                self.storage_path + ".journal",
+                fsync=GLOBAL_CONFIG.gcs_journal_fsync,
+            )
+            # startup compaction: everything just restored goes into one
+            # fresh snapshot, then both journals reset — replay stays O(one
+            # snapshot interval), not O(uptime)
+            try:
+                self._startup_compact()
+            except Exception:
+                logger.exception("GCS startup snapshot compaction failed")
         await self.server.start_async()
         loop = asyncio.get_running_loop()
         self._health_task = loop.create_task(self._health_loop())
         if self.storage_path:
             self._persist_task = loop.create_task(self._persist_loop())
+        if self._recovering or any(
+            pg.state in (PG_PENDING, PG_RESCHEDULING)
+            for pg in self.placement_groups.values()
+        ):
+            loop.create_task(self._recover_after_grace())
         self._started.set()
 
     async def stop(self):
@@ -155,6 +293,8 @@ class GcsServer:
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
             self._persist_now()
+        if self._journal_w is not None:
+            self._journal_w.close()
         await self.server.stop_async()
 
     # ---------------- persistence (file backend) ----------------
@@ -209,14 +349,108 @@ class GcsServer:
                     "GCS snapshot mirror exists but is unreadable; "
                     "starting empty"
                 )
-        if snap is None:
+        if snap is not None:
+            self.kv = snap.get("kv", {})
+            self.jobs = snap.get("jobs", {})
+            for d in snap.get("actors") or []:
+                rec = ActorRecord.from_state(d)
+                self.actors[rec.actor_id] = rec
+            for d in snap.get("pgs") or []:
+                rec = PgRecord.from_state(d)
+                self.placement_groups[rec.pg_id] = rec
+        # journal replay ON TOP of the snapshot: ``.old`` first (exists
+        # only when a rotation's snapshot never landed), then the current
+        # log. Records are absolute values — replay is idempotent.
+        replayed = 0
+        for path in (self.storage_path + ".journal.old",
+                     self.storage_path + ".journal"):
+            for rec in GcsJournal.replay(path):
+                try:
+                    self._journal_apply(rec)
+                    replayed += 1
+                except Exception:
+                    logger.exception("bad journal record skipped: %r",
+                                     rec[:1])
+        if snap is None and not replayed:
             return
-        self.kv = snap.get("kv", {})
-        self.jobs = snap.get("jobs", {})
+        # named-actor index + recovery marks derive from the records
+        for rec in self.actors.values():
+            if rec.name and rec.state != DEAD:
+                self.named_actors.setdefault(rec.name, rec.actor_id)
+            if rec.state in (ALIVE, PENDING, RESTARTING):
+                # the worker may well still be alive — wait for its raylet
+                # to re-register and reclaim it before re-placing
+                rec.state = RESTARTING
+                self._recovering.add(rec.actor_id)
         logger.info(
-            "restored GCS tables (%d kv keys, %d jobs)",
-            len(self.kv), len(self.jobs),
+            "restored GCS tables (%d kv keys, %d jobs, %d actors, %d pgs; "
+            "%d journal records replayed)",
+            len(self.kv), len(self.jobs), len(self.actors),
+            len(self.placement_groups), replayed,
         )
+
+    def _journal_apply(self, rec: List):
+        op = rec[0]
+        if op == "kv":
+            key, value = rec[1], rec[2]
+            if value is None:
+                self.kv.pop(key, None)
+            else:
+                self.kv[key] = value
+        elif op == "job":
+            self.jobs[bytes(rec[1])] = rec[2]
+        elif op == "actor":
+            arec = ActorRecord.from_state(rec[1])
+            self.actors[arec.actor_id] = arec
+            if arec.name and arec.state == DEAD and (
+                self.named_actors.get(arec.name) == arec.actor_id
+            ):
+                self.named_actors.pop(arec.name, None)
+        elif op == "pg":
+            prec = PgRecord.from_state(rec[1])
+            self.placement_groups[prec.pg_id] = prec
+
+    # -- journal write side (no-ops on the memory backend) --
+    def _journal(self, rec: List):
+        j = self._journal_w
+        if j is None:
+            return
+        try:
+            j.append(rec)
+        except Exception:
+            logger.exception("GCS journal append failed; journaling disabled")
+            self._journal_w = None
+        self._mark_dirty()
+
+    def _journal_actor(self, rec: "ActorRecord"):
+        if self._journal_w is not None:
+            self._journal(["actor", rec.to_state()])
+
+    def _journal_pg(self, rec: "PgRecord"):
+        if self._journal_w is not None:
+            self._journal(["pg", rec.to_state()])
+
+    async def _recover_after_grace(self):
+        """Journal-restored runtime state reconciliation: give raylets one
+        grace window to re-register and reclaim their live actors
+        (rpc_restore_actors); whatever stays unclaimed is re-placed from
+        its journaled spec. Restarts spent on recovery are free — the
+        actor didn't crash, the GCS did."""
+        await asyncio.sleep(GLOBAL_CONFIG.gcs_actor_recovery_grace_s)
+        loop = asyncio.get_running_loop()
+        for aid in list(self._recovering):
+            self._recovering.discard(aid)
+            rec = self.actors.get(aid)
+            if rec is None or rec.state != RESTARTING:
+                continue
+            logger.info("re-placing journal-restored actor %s "
+                        "(raylet never reclaimed it)", aid.hex()[:12])
+            rec.address = None
+            self._journal_actor(rec)
+            loop.create_task(self._place_actor(rec))
+        for pg in self.placement_groups.values():
+            if pg.state in (PG_PENDING, PG_RESCHEDULING):
+                loop.create_task(self._place_pg(pg))
 
     def _mark_dirty(self):
         self._dirty = True
@@ -224,9 +458,24 @@ class GcsServer:
     def _snapshot(self) -> Dict:
         """Copy tables ON the event-loop thread (no concurrent mutation) and
         clear the dirty flag atomically with the copy — a put landing after
-        this is a NEW dirty state."""
+        this is a NEW dirty state. The journal rotates in the same tick, so
+        ``.old`` holds exactly the delta this snapshot captures; rotation
+        is skipped while a previous ``.old`` is still pending (its
+        snapshot flush failed), which only means a longer replay."""
         self._dirty = False
-        return {"kv": dict(self.kv), "jobs": dict(self.jobs)}
+        if self._journal_w is not None and self._journal_rotated_old is None:
+            old = self.storage_path + ".journal.old"
+            if not os.path.exists(old):
+                try:
+                    self._journal_rotated_old = self._journal_w.rotate()
+                except Exception:
+                    logger.exception("journal rotation failed")
+        return {
+            "kv": dict(self.kv),
+            "jobs": dict(self.jobs),
+            "actors": [r.to_state() for r in self.actors.values()],
+            "pgs": [r.to_state() for r in self.placement_groups.values()],
+        }
 
     def _write_snapshot(self, blob: bytes):
         """Atomic snapshot write (pre-serialized bytes — pickled once,
@@ -261,6 +510,15 @@ class GcsServer:
 
         blob = pickle.dumps(snap, protocol=5)  # serialized ONCE for both
         self._write_snapshot(blob)
+        # the snapshot covering the rotated-out journal segment landed:
+        # that segment is now redundant
+        old = self._journal_rotated_old
+        if old is not None:
+            self._journal_rotated_old = None
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
         try:
             mirror = self._mirror_storage()
             if mirror is not None:
@@ -268,6 +526,19 @@ class GcsServer:
         except Exception:  # incl. an unconstructible backend (bad URI)
             logger.exception("GCS snapshot mirror write failed "
                              "(local snapshot intact)")
+
+    def _startup_compact(self):
+        """Fold the restored state into one fresh snapshot and reset the
+        journals (called before serving: no concurrent mutation)."""
+        import pickle
+
+        self._write_snapshot(pickle.dumps(self._snapshot(), protocol=5))
+        self._journal_rotated_old = None
+        try:
+            os.unlink(self.storage_path + ".journal.old")
+        except OSError:
+            pass
+        self._journal_w.reset()
 
     def _persist_now(self):
         if self.storage_path:
@@ -322,6 +593,7 @@ class GcsServer:
             return False
         self.kv[key] = value
         self._mark_dirty()
+        self._journal(["kv", key, value])
         return True
 
     async def rpc_kv_get(self, conn, key):
@@ -329,6 +601,7 @@ class GcsServer:
 
     async def rpc_kv_del(self, conn, key):
         self._mark_dirty()
+        self._journal(["kv", key, None])
         return self.kv.pop(key, None) is not None
 
     async def rpc_kv_exists(self, conn, key):
@@ -343,6 +616,9 @@ class GcsServer:
         self.nodes[info.node_id] = info
         self.node_heartbeat[info.node_id] = time.monotonic()
         conn.on_close = self._make_node_close_handler(info.node_id)
+        # chaos-plane peer tag: lets node-pair partition rules match this
+        # server-side connection
+        conn.chaos_peer = "raylet-" + info.node_id.hex()[:12]
         self._raylet_clients[info.node_id] = conn
         logger.info("node registered: %s", info.node_id.hex()[:12])
         self._publish("nodes", [info.to_wire()])
@@ -350,18 +626,29 @@ class GcsServer:
 
     def _make_node_close_handler(self, node_id: bytes):
         def on_close(conn):
-            # Raylet connection dropped => node presumed dead.
+            # Raylet connection dropped => node presumed dead — unless a
+            # re-registration already superseded this conn (a raylet
+            # cycling its GCS link must not kill its fresh registration).
+            if self._raylet_clients.get(node_id) is not conn:
+                return
             asyncio.get_running_loop().create_task(self._mark_node_dead(node_id))
 
         return on_close
 
     async def rpc_heartbeat(self, conn, data):
         node_id, resources = data
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            # This GCS doesn't know the node (journal-restored after a
+            # SIGKILL, or the node was declared dead during a partition/
+            # blackout): tell the raylet to run the full re-registration —
+            # register + resubscribe + replay its live actors.
+            return {"reregister": True}
         self.node_heartbeat[node_id] = time.monotonic()
         if resources:
             self.node_resources[node_id] = resources
             self._publish("resources", self._resource_view())
-        return True
+        return {"ok": True}
 
     async def rpc_get_all_nodes(self, conn, _):
         return [n.to_wire() for n in self.nodes.values()]
@@ -391,8 +678,10 @@ class GcsServer:
                 locs = [l for l in locs if l != node_id]
                 if locs:
                     self.kv[key] = rpc.msgpack.packb(locs)
+                    self._journal(["kv", key, self.kv[key]])
                 else:
                     self.kv.pop(key, None)
+                    self._journal(["kv", key, None])
         # Placement groups lose the dead node's bundles -> reschedule them.
         for pg in self.placement_groups.values():
             lost = [i for i, n in enumerate(pg.assignment) if n == node_id]
@@ -401,6 +690,7 @@ class GcsServer:
                     pg.assignment[i] = None
                 if pg.state == PG_CREATED:
                     pg.state = PG_RESCHEDULING
+                    self._journal_pg(pg)
                     self._publish("placement_groups", [pg.to_wire()])
                     asyncio.get_running_loop().create_task(self._place_pg(pg))
         # Actors on that node die (and maybe restart elsewhere).
@@ -426,6 +716,7 @@ class GcsServer:
         job_id, meta = data
         self.jobs[job_id] = dict(meta, start_time=time.time())
         self._mark_dirty()
+        self._journal(["job", job_id, self.jobs[job_id]])
         return True
 
     async def rpc_get_jobs(self, conn, _):
@@ -433,16 +724,25 @@ class GcsServer:
 
     # ---------------- actors ----------------
     async def rpc_create_actor(self, conn, data):
-        """Register + asynchronously place an actor. Returns immediately."""
+        """Register + asynchronously place an actor. Returns immediately.
+
+        Idempotent at the APPLICATION level, keyed on the client-generated
+        actor id: the rpc-layer dedup cache dies with a SIGKILLed GCS, so
+        a client replaying create_actor against the restarted process must
+        land on the journal-restored record, not re-create (or collide
+        with its own name registration)."""
         spec = data
         actor_id = spec["actor_id"]
+        if actor_id in self.actors:
+            return {"ok": True}  # duplicate submission (replay): applied once
         name = spec.get("name_register") or ""
         if name:
-            if name in self.named_actors:
+            if self.named_actors.get(name, actor_id) != actor_id:
                 return {"ok": False, "error": f"actor name {name!r} taken"}
             self.named_actors[name] = actor_id
         rec = ActorRecord(actor_id, spec, name=name)
         self.actors[actor_id] = rec
+        self._journal_actor(rec)
         asyncio.get_running_loop().create_task(self._place_actor(rec))
         return {"ok": True}
 
@@ -611,6 +911,7 @@ class GcsServer:
                     return
                 rec.address = reply["address"]
                 rec.state = ALIVE
+                self._journal_actor(rec)
                 self._publish("actors", [rec.to_wire()])
                 return
             logger.warning("actor %s placement rejected: %s",
@@ -639,6 +940,7 @@ class GcsServer:
         rec.death_cause = reason
         if rec.name:
             self.named_actors.pop(rec.name, None)
+        self._journal_actor(rec)
         self._publish("actors", [rec.to_wire()])
 
     async def _on_actor_death(self, rec: ActorRecord, reason: str):
@@ -650,6 +952,7 @@ class GcsServer:
             rec.num_restarts += 1
             rec.state = RESTARTING
             rec.address = None
+            self._journal_actor(rec)
             self._publish("actors", [rec.to_wire()])
             logger.info("restarting actor %s (%d restarts)",
                         rec.actor_id.hex()[:12], rec.num_restarts)
@@ -660,31 +963,49 @@ class GcsServer:
 
     async def rpc_restore_actors(self, conn, hosted: List[Dict]):
         """A (re-)registering raylet replays its live actors so a restarted
-        GCS rebuilds its actor/named-actor tables (GCS FT — the reference
-        recovers this from Redis; here the raylets ARE the durable source
-        for runtime state)."""
+        GCS rebuilds its actor table (GCS FT). Journal-restored records
+        awaiting reclaim (``_recovering``) are ADOPTED — state back to
+        ALIVE at the replayed address, no re-placement, no restart spent.
+        Replayed actors whose record meanwhile moved on (restarted
+        elsewhere, or killed) are returned as ``stale`` so the raylet
+        reaps the orphaned worker instead of leaking it."""
         restored = 0
+        stale: List[bytes] = []
+        touched: List[bytes] = []
         for item in hosted:
             spec = item["spec"]
             actor_id = bytes(spec["actor_id"])
-            if actor_id in self.actors:
-                continue
             name = spec.get("name_register") or ""
-            rec = ActorRecord(actor_id, spec, name=name)
-            rec.state = ALIVE
-            rec.address = item["address"]
-            self.actors[actor_id] = rec
-            if name:
-                self.named_actors.setdefault(name, actor_id)
-            restored += 1
+            rec = self.actors.get(actor_id)
+            if rec is None:
+                rec = ActorRecord(actor_id, spec, name=name)
+                rec.state = ALIVE
+                rec.address = item["address"]
+                self.actors[actor_id] = rec
+                if name:
+                    self.named_actors.setdefault(name, actor_id)
+                restored += 1
+                touched.append(actor_id)
+            elif actor_id in self._recovering:
+                self._recovering.discard(actor_id)
+                rec.state = ALIVE
+                rec.address = item["address"]
+                if rec.name:
+                    self.named_actors.setdefault(rec.name, actor_id)
+                restored += 1
+                touched.append(actor_id)
+            elif rec.state == ALIVE and rec.address == item["address"]:
+                pass  # already known (idempotent replay)
+            else:
+                stale.append(actor_id)
+        for aid in touched:
+            self._journal_actor(self.actors[aid])
         if restored:
             logger.info("restored %d live actor(s) from a raylet", restored)
             self._publish(
-                "actors",
-                [self.actors[bytes(i["spec"]["actor_id"])].to_wire()
-                 for i in hosted],
+                "actors", [self.actors[aid].to_wire() for aid in touched]
             )
-        return restored
+        return {"restored": restored, "stale": stale}
 
     async def rpc_report_actor_death(self, conn, data):
         """Raylet reports an actor worker exited."""
@@ -705,6 +1026,7 @@ class GcsServer:
             return False
         if no_restart:
             rec.restarts_left = 0
+            self._journal_actor(rec)
         if rec.address is None:
             # Still placing (PENDING/RESTARTING): mark dead now; _place_actor
             # checks state and kills a worker that wins the race.
@@ -746,6 +1068,10 @@ class GcsServer:
 
     async def rpc_create_placement_group(self, conn, spec: Dict):
         pg_id = spec["pg_id"]
+        if pg_id in self.placement_groups:
+            # duplicate submission (client replay across a GCS restart):
+            # the journal-restored record owns the 2PC, apply once
+            return {"ok": True}
         rec = PgRecord(
             pg_id,
             [dict(b) for b in spec["bundles"]],
@@ -756,6 +1082,7 @@ class GcsServer:
                                 "STRICT_SPREAD"):
             return {"ok": False, "error": f"bad strategy {rec.strategy!r}"}
         self.placement_groups[pg_id] = rec
+        self._journal_pg(rec)
         asyncio.get_running_loop().create_task(self._place_pg(rec))
         return {"ok": True}
 
@@ -776,6 +1103,7 @@ class GcsServer:
         rec.state = PG_REMOVED
         nodes = {n for n in rec.assignment if n is not None}
         rec.assignment = [None] * len(rec.bundles)
+        self._journal_pg(rec)
         for nid in nodes:
             raylet = self._raylet_clients.get(nid)
             if raylet is not None and not raylet.closed:
@@ -926,6 +1254,7 @@ class GcsServer:
             if any(a is None for a in rec.assignment):
                 continue  # a commit failed or a node died: re-place the rest
             rec.state = PG_CREATED
+            self._journal_pg(rec)
             self._publish("placement_groups", [rec.to_wire()])
             logger.info("placement group %s created over %d node(s)",
                         rec.pg_id.hex()[:12], len(set(plan)))
@@ -943,6 +1272,9 @@ class GcsServer:
         locs = set(bytes(l) for l in rpc.msgpack.unpackb(locs)) if locs else set()
         locs.add(node_id)
         self.kv[key] = rpc.msgpack.packb([bytes(l) for l in locs])
+        # journaled so a live GCS restart loses no object directory entries
+        # (a lost loc: entry surfaces as ObjectLost to the owner)
+        self._journal(["kv", key, self.kv[key]])
         return True
 
     async def rpc_remove_object_location(self, conn, data):
@@ -955,8 +1287,10 @@ class GcsServer:
         s.discard(node_id)
         if s:
             self.kv[key] = rpc.msgpack.packb(sorted(s))
+            self._journal(["kv", key, self.kv[key]])
         else:
             self.kv.pop(key, None)
+            self._journal(["kv", key, None])
         return True
 
     async def rpc_get_object_locations(self, conn, oid):
@@ -970,6 +1304,8 @@ class GcsServer:
         to copy-holding raylets."""
         key = "loc:" + oid_bytes.hex()
         locs = self.kv.pop(key, None)
+        if locs is not None:
+            self._journal(["kv", key, None])
         nodes = (
             [bytes(n) for n in rpc.msgpack.unpackb(locs)] if locs else []
         )
@@ -1054,6 +1390,15 @@ class GcsServer:
             "num_nodes": len([n for n in self.nodes.values() if n.alive]),
             "num_actors": len(self.actors),
             "kv_keys": len(self.kv),
+            "num_pgs": len(self.placement_groups),
+            "subs": {
+                ch: len([c for c in conns if not c.closed])
+                for ch, conns in self.subs.items()
+            },
+            "journal_appended": (
+                self._journal_w.appended if self._journal_w else None
+            ),
+            "recovering_actors": len(self._recovering),
             "method_stats": rpc.method_stats().snapshot(),
         }
 
@@ -1062,9 +1407,11 @@ def main():
     import argparse
     import sys
 
+    from ray_tpu._private import chaos
     from ray_tpu._private.fate_share import fate_share_with_parent
 
     fate_share_with_parent()
+    chaos.install_from_env("gcs")
     p = argparse.ArgumentParser()
     p.add_argument("--sock")
     p.add_argument("--config", default="")

@@ -181,13 +181,37 @@ class Raylet:
             self.gcs_addr, rpc.handler_table(self), timeout=30, name="raylet->gcs"
         )
 
+    async def _gcs_call_replayed(self, method, data, timeout=10.0,
+                                 attempts=6):
+        """At-least-once call on the raylet's GCS conn: one request id
+        across attempts (server-side dedup applies the mutation once),
+        exponential backoff + jitter between them — a chaos-dropped frame
+        costs one timeout, not the registration."""
+        import random as _random
+
+        rid = os.urandom(16)
+        backoff = 0.2
+        for i in range(attempts):
+            try:
+                # attempt timeouts grow (a dropped frame costs ~2s, not
+                # the full budget); a slow handler joins via dedup
+                return await self.gcs.call_async(
+                    method, data, timeout=min(timeout, 2.0 * (1 << i)),
+                    rid=rid,
+                )
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                if i == attempts - 1 or self._stopping:
+                    raise
+                await asyncio.sleep(backoff * (0.5 + _random.random() * 0.5))
+                backoff = min(backoff * 2.0, 2.0)
+
     async def _register_with_gcs(self):
-        """Connect + register + subscribe; re-armed on connection loss so a
-        restarted GCS (file-backed FT) gets this node back (parity:
-        reference NotifyGCSRestart + raylet re-registration,
+        """Connect + register + subscribe + replay live actors; re-armed on
+        connection loss so a restarted GCS (file-backed FT) gets this node
+        back (parity: reference NotifyGCSRestart + raylet re-registration,
         node_manager.proto:358)."""
         self.gcs = await self._connect_gcs()
-        reply = await self.gcs.call_async(
+        reply = await self._gcs_call_replayed(
             "register_node",
             NodeInfo(
                 node_id=self.node_id,
@@ -198,35 +222,67 @@ class Raylet:
             ).to_wire(),
         )
         GLOBAL_CONFIG.load(reply["config"])
-        snap = await self.gcs.call_async("subscribe", ["nodes", "resources"])
+        snap = await self._gcs_call_replayed(
+            "subscribe", ["nodes", "resources"]
+        )
         for n in snap.get("nodes", []):
             self._on_nodes_update([n])
         self.cluster_resources = snap.get("resources") or {}
         if self.hosted_actors:
-            # replay live actors into the (possibly restarted) GCS table
+            # replay live actors into the (possibly restarted) GCS table;
+            # the GCS answers with instances its table has since moved
+            # past (restarted elsewhere / killed) — reap those workers
             try:
-                await self.gcs.call_async(
+                r = await self._gcs_call_replayed(
                     "restore_actors", list(self.hosted_actors.values()),
                     timeout=30,
                 )
+                for aid in (r.get("stale") or []) if isinstance(r, dict) else []:
+                    self._reap_stale_actor(bytes(aid))
             except Exception:
                 logger.warning("actor-table replay to GCS failed")
         self.gcs.add_close_callback(self._on_gcs_conn_lost)
 
+    def _reap_stale_actor(self, actor_id: bytes):
+        """The GCS re-placed (or killed) this actor while we were gone:
+        our local instance is an orphan — kill its worker."""
+        self.hosted_actors.pop(actor_id, None)
+        for w in self.workers.values():
+            if w.actor_id == actor_id:
+                logger.warning("reaping stale actor instance %s",
+                               actor_id.hex()[:12])
+                w.actor_id = None  # suppress the death report: not news
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.kill()
+                break
+
     def _on_gcs_conn_lost(self, conn):
-        if self._stopping:
-            return
+        if self._stopping or conn is not self.gcs:
+            return  # superseded conn (a re-registration already replaced it)
         logger.warning("GCS connection lost; reconnecting...")
         asyncio.get_running_loop().create_task(self._gcs_reconnect_loop())
 
     async def _gcs_reconnect_loop(self):
-        while not self._stopping:
-            try:
-                await self._register_with_gcs()
-                logger.info("re-registered with restarted GCS")
-                return
-            except Exception:
-                await asyncio.sleep(1.0)
+        import random as _random
+
+        if getattr(self, "_gcs_reconnecting", False):
+            return
+        self._gcs_reconnecting = True
+        backoff = 0.2
+        try:
+            while not self._stopping:
+                try:
+                    await self._register_with_gcs()
+                    logger.info("re-registered with restarted GCS")
+                    self._pump_infeasible()
+                    return
+                except Exception:
+                    # exponential backoff + jitter: N raylets must not
+                    # hammer a just-restarting GCS in lockstep
+                    await asyncio.sleep(backoff * (0.5 + _random.random()))
+                    backoff = min(backoff * 2.0, 5.0)
+        finally:
+            self._gcs_reconnecting = False
 
     # ------------- pubsub from GCS -------------
     async def rpc_publish(self, conn, data):
@@ -331,7 +387,7 @@ class Raylet:
         period = GLOBAL_CONFIG.health_check_period_ms / 1e3
         while not self._stopping:
             try:
-                await self.gcs.call_async(
+                reply = await self.gcs.call_async(
                     "heartbeat",
                     [
                         self.node_id,
@@ -341,6 +397,15 @@ class Raylet:
                     ],
                     timeout=10,
                 )
+                if isinstance(reply, dict) and reply.get("reregister"):
+                    # The GCS doesn't know us (restarted, or it declared us
+                    # dead during a partition/blackout): cycle the conn —
+                    # its close handler runs the full re-registration
+                    # (register + resubscribe + actor replay).
+                    logger.warning(
+                        "GCS no longer recognizes this node; re-registering"
+                    )
+                    self.gcs._do_close()
             except Exception:
                 if self._stopping:
                     return
@@ -420,10 +485,11 @@ class Raylet:
             self.hosted_actors.pop(w.actor_id, None)
         if w.actor_id is not None and not self._stopping:
             try:
-                await self.gcs.call_async(
+                # replayed: a death report lost to a partition/blackout
+                # would strand the actor as ALIVE in the GCS forever
+                await self._gcs_call_replayed(
                     "report_actor_death",
                     [w.actor_id, "actor worker process died", False],
-                    timeout=10,
                 )
             except Exception:
                 pass
@@ -1612,6 +1678,7 @@ def main():
     import argparse
     import json
 
+    from ray_tpu._private import chaos
     from ray_tpu._private.fate_share import fate_share_with_parent
 
     fate_share_with_parent()
@@ -1631,6 +1698,7 @@ def main():
         format="[raylet %(asctime)s] %(levelname)s %(message)s",
         stream=sys.stderr,
     )
+    chaos.install_from_env("raylet-" + args.node_id[:12])
     if args.config:
         GLOBAL_CONFIG.load(json.loads(args.config))
 

@@ -14,6 +14,11 @@ the raylet host:port; grpc_server.h binds TCP):
 
 Frame format: [u32 len][msgpack payload].
 Message: [kind, seqno, method, data]  kind: 0=request 1=reply 2=error 3=notify.
+Requests MAY carry a 5th element, a request id (16 random bytes): the
+server applies such requests effectively-once (process-global request-id
+dedup), so clients can replay them across reconnects/timeouts without
+double-applying mutations (at-least-once transport + idempotent apply).
+Frames pass through the chaos plane (``chaos.py``) when one is installed.
 """
 
 from __future__ import annotations
@@ -22,12 +27,16 @@ import asyncio
 import collections
 import itertools
 import logging
+import os
+import random
 import threading
 import time
 import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
+
+from ray_tpu._private import chaos as _chaos
 
 _REQUEST, _REPLY, _ERROR, _NOTIFY = 0, 1, 2, 3
 
@@ -133,6 +142,10 @@ class Connection:
         # loop (no handler task) — the data-plane reply hot path
         self.sync_notify: Dict[str, Callable] = {}
         self._cork = bytearray()  # send_notify_corked accumulator
+        # chaos-plane link identity: servers may tag the peer (e.g. the GCS
+        # tags a registering raylet's conn) so node-pair partitions match
+        self.chaos_peer = ""
+        self._chaos_seq = 0
 
     def start(self):
         self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -146,10 +159,11 @@ class Connection:
                     raise ConnectionError("frame too large")
                 body = await self.reader.readexactly(n)
                 msg = msgpack.unpackb(body, raw=False)
-                kind, seqno, method, data = msg
+                kind, seqno, method, data = msg[0], msg[1], msg[2], msg[3]
+                rid = msg[4] if len(msg) > 4 else None
                 if kind == _REQUEST:
                     asyncio.get_running_loop().create_task(
-                        self._handle(seqno, method, data)
+                        self._handle(seqno, method, data, rid)
                     )
                 elif kind == _NOTIFY:
                     fn = self.sync_notify.get(method)
@@ -176,40 +190,73 @@ class Connection:
         finally:
             self._do_close()
 
-    async def _handle(self, seqno, method, data):
-        try:
-            t0 = time.monotonic()
-            reply = await self.handler(self, method, data)
+    async def _handle(self, seqno, method, data, rid=None):
+        t0 = time.monotonic()
+        kind, payload = await run_idempotent(
+            rid, lambda: self.handler(self, method, data)
+        )
+        if kind == _REPLY:
             _global_stats.record(method, (time.monotonic() - t0) * 1e3)
-            if seqno is not None:
-                await self._send(_REPLY, seqno, method, reply)
-        except Exception:
-            if seqno is not None:
-                try:
-                    await self._send(_ERROR, seqno, method, traceback.format_exc())
-                except Exception:
-                    pass
+        if seqno is not None:
+            try:
+                await self._send(kind, seqno, method, payload)
+            except Exception:
+                pass
 
-    async def _send(self, kind, seqno, method, data):
+    def _chaos_gate(self, frame: bytes) -> bool:
+        """Run one framed buffer through the fault plane (loop thread).
+        Returns True when the plane consumed it (dropped, or wrote it —
+        possibly delayed/duplicated — itself); False = caller writes."""
+        pl = _chaos._PLANE
+        if pl is None:
+            return False
+        link = self.name + ("|" + self.chaos_peer if self.chaos_peer else "")
+        seq = self._chaos_seq
+        self._chaos_seq += 1
+        copies, delay = pl.decide(link, seq)
+        if copies == 0:
+            return True
+        if copies == 1 and delay <= 0:
+            return False
+        data = frame * copies
+
+        def _write():
+            if not (self._closed or self.writer.is_closing()):
+                self.writer.write(data)
+
+        if delay > 0:
+            asyncio.get_running_loop().call_later(delay, _write)
+        else:
+            _write()
+        return True
+
+    async def _send(self, kind, seqno, method, data, rid=None):
         # Hot path: ONE buffer append per frame (the transport coalesces
         # same-tick frames into one syscall) and drain only past the
         # high-water mark — per-frame drain() costs a task switch each
         # and throttled nothing below the watermark anyway.
-        body = msgpack.packb([kind, seqno, method, data], use_bin_type=True)
+        msg = [kind, seqno, method, data]
+        if rid is not None:
+            msg.append(rid)
+        body = msgpack.packb(msg, use_bin_type=True)
         if self._closed or self.writer.is_closing():
             raise ConnectionError(f"connection {self.name} closed")
-        self.writer.write(len(body).to_bytes(4, "big") + body)
+        frame = len(body).to_bytes(4, "big") + body
+        if _chaos._PLANE is not None and self._chaos_gate(frame):
+            return
+        self.writer.write(frame)
         if self.writer.transport.get_write_buffer_size() > _DRAIN_HIGH_WATER:
             async with self._write_lock:
                 await self.writer.drain()
 
-    async def call_async(self, method: str, data: Any, timeout=None) -> Any:
+    async def call_async(self, method: str, data: Any, timeout=None,
+                         rid=None) -> Any:
         seqno = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
         try:
             try:
-                await self._send(_REQUEST, seqno, method, data)
+                await self._send(_REQUEST, seqno, method, data, rid)
             except Exception as e:
                 raise SendError(str(e)) from e
             if timeout is not None:
@@ -229,7 +276,10 @@ class Connection:
         body = msgpack.packb([_NOTIFY, None, method, data], use_bin_type=True)
         if self._closed or self.writer.is_closing():
             raise SendError(f"connection {self.name} closed")
-        self.writer.write(len(body).to_bytes(4, "big") + body)
+        frame = len(body).to_bytes(4, "big") + body
+        if _chaos._PLANE is not None and self._chaos_gate(frame):
+            return
+        self.writer.write(frame)
 
     def send_notify_corked(self, method: str, data: Any):
         """Like send_notify but frames accumulate in a cork buffer; the
@@ -239,7 +289,10 @@ class Connection:
         body = msgpack.packb([_NOTIFY, None, method, data], use_bin_type=True)
         if self._closed or self.writer.is_closing():
             raise SendError(f"connection {self.name} closed")
-        self._cork += len(body).to_bytes(4, "big") + body
+        frame = len(body).to_bytes(4, "big") + body
+        if _chaos._PLANE is not None and self._chaos_gate(frame):
+            return
+        self._cork += frame
 
     def flush_cork(self):
         if self._cork:
@@ -296,6 +349,58 @@ class RpcError(Exception):
 
 class SendError(ConnectionError):
     """The request was never written to the socket (safe to retry)."""
+
+
+# ---------------- request-id dedup (idempotent apply) ----------------
+# At-least-once transport (client replays across reconnects/timeouts)
+# + this = effectively-once: a retried mutation is applied ONCE and the
+# cached reply is re-sent. Process-global (retries arrive on NEW
+# connections after a reconnect); touched only from the process's
+# handler event loop.
+
+# Bounded reply retention: entries evict LRU-insertion order. Replies can
+# be sizable (table snapshots), so the cap stays modest — an evicted rid's
+# duplicate simply re-runs its handler, which only matters for mutations
+# replayed >2048 requests later (not a window the retry loop can produce).
+_DEDUP_MAX = 2048
+_dedup_done: "collections.OrderedDict[bytes, tuple]" = collections.OrderedDict()
+_dedup_inflight: Dict[bytes, asyncio.Future] = {}
+
+
+async def run_idempotent(rid, thunk) -> tuple:
+    """Run ``await thunk()`` under request-id dedup. Returns
+    ``(_REPLY, reply)`` or ``(_ERROR, traceback_str)`` — for a duplicate
+    rid the stored outcome is returned without re-running the handler;
+    a duplicate racing an in-flight first attempt awaits that attempt."""
+    if rid is None:
+        try:
+            return (_REPLY, await thunk())
+        except Exception:
+            return (_ERROR, traceback.format_exc())
+    rid = bytes(rid)
+    hit = _dedup_done.get(rid)
+    if hit is not None:
+        _dedup_done.move_to_end(rid)
+        return hit
+    inflight = _dedup_inflight.get(rid)
+    if inflight is not None:
+        return await asyncio.shield(inflight)
+    fut = asyncio.get_running_loop().create_future()
+    _dedup_inflight[rid] = fut
+    try:
+        try:
+            result = (_REPLY, await thunk())
+        except Exception:
+            result = (_ERROR, traceback.format_exc())
+        _dedup_done[rid] = result
+        while len(_dedup_done) > _DEDUP_MAX:
+            _dedup_done.popitem(last=False)
+        fut.set_result(result)
+        return result
+    finally:
+        _dedup_inflight.pop(rid, None)
+        if not fut.done():  # safety: never strand a waiting duplicate
+            fut.set_result((_ERROR, "request aborted"))
 
 
 _global_stats = MethodStats()
@@ -362,7 +467,14 @@ class Client:
     """Sync facade over a Connection for non-IO threads. Remembers its
     address so `call` can transparently reconnect after the server restarts
     (GCS fault tolerance: the file-backed GCS comes back at the same
-    address)."""
+    address).
+
+    Delivery semantics: ``call`` on an address-remembering client is
+    AT-LEAST-ONCE with idempotent apply — every attempt carries one
+    request id, the client replays across reconnects / per-attempt
+    timeouts with exponential backoff + jitter, and the server's
+    request-id dedup (``run_idempotent``) applies the mutation once and
+    replays the cached reply. Pass ``retry=False`` for fire-once."""
 
     def __init__(self, conn: Connection, io: EventLoopThread,
                  addr: str = "", handler=None, name: str = ""):
@@ -387,13 +499,14 @@ class Client:
             io, addr=addr, handler=handler, name=name,
         )
 
-    def _maybe_reconnect(self):
+    def _maybe_reconnect(self, timeout: float = 10.0):
         if not self.conn.closed or not self._addr or self._closed_by_user:
             return
         with self._reconnect_lock:  # one reconnect wins; no orphan conns
             if self.conn.closed and not self._closed_by_user:
                 self.conn = self.io.run(
-                    connect_async(self._addr, self._handler, 10.0, self._name)
+                    connect_async(self._addr, self._handler, timeout,
+                                  self._name)
                 )
                 if self.on_reconnect is not None:
                     try:
@@ -401,9 +514,82 @@ class Client:
                     except Exception:
                         pass
 
-    def call(self, method: str, data: Any = None, timeout=None) -> Any:
-        self._maybe_reconnect()
-        return self.io.run(self.conn.call_async(method, data, timeout=timeout))
+    @staticmethod
+    def _cfg(name: str, default: float) -> float:
+        try:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            return float(GLOBAL_CONFIG.get(name))
+        except Exception:
+            return default
+
+    def call(self, method: str, data: Any = None, timeout=None,
+             retry: Optional[bool] = None, dedup: bool = True) -> Any:
+        if retry is None:
+            retry = bool(self._addr)
+        if not retry:
+            self._maybe_reconnect()
+            return self.io.run(
+                self.conn.call_async(method, data, timeout=timeout)
+            )
+        # At-least-once replay: per-attempt timeout, exponential backoff +
+        # jitter between attempts. An EXPLICIT caller timeout stays the
+        # TOTAL bound (status paths keep their latency contract); with no
+        # timeout the retry window (``client_retry_window_s``) bounds the
+        # call — wide enough to ride a GCS restart / partition / blackout,
+        # narrow enough that a permanently-dead server still errors.
+        # RpcError (the handler ran and raised) is never retried; a
+        # slow-but-running first attempt is NOT double-applied (the retry
+        # joins it through the server's in-flight dedup entry).
+        # ``dedup=False`` replays WITHOUT a request id — for handlers that
+        # are application-idempotent but CONNECTION-AFFINE (e.g.
+        # subscribe, which must register the conn the retry arrives on;
+        # a cached reply would skip that).
+        rid = os.urandom(16) if dedup else None
+        # Per-attempt timeouts START SHORT and grow (1s, 2s, 4s... capped
+        # below the caller's budget): a dropped frame costs ~1s, not the
+        # whole budget, and the window fits many replays. A genuinely slow
+        # handler is safe either way — the retry joins the in-flight first
+        # attempt through the server's dedup entry and returns when it
+        # completes.
+        cap = self._cfg("client_call_attempt_timeout_s", 5.0)
+        deadline = time.monotonic() + (
+            timeout if timeout is not None
+            else self._cfg("client_retry_window_s", 20.0)
+        )
+        backoff = 0.05
+        attempt = 0
+        conn_failures = 0  # consecutive cannot-even-connect failures
+        while True:
+            attempt_timeout = min(cap, 1.0 * (1 << min(attempt, 6)))
+            if timeout is not None:
+                attempt_timeout = min(attempt_timeout, timeout)
+            attempt += 1
+            try:
+                try:
+                    self._maybe_reconnect(timeout=2.0)
+                    conn_failures = 0
+                except Exception:
+                    # Transport won't even re-establish. A restarting GCS
+                    # needs a few seconds, but a server that is GONE must
+                    # not cost every caller the whole retry window.
+                    conn_failures += 1
+                    if conn_failures >= 4:
+                        raise
+                    raise ConnectionError("reconnect failed")
+                return self.io.run(self.conn.call_async(
+                    method, data, timeout=attempt_timeout, rid=rid
+                ))
+            except RpcError:
+                raise
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    TimeoutError):
+                if self._closed_by_user:
+                    raise
+                if conn_failures >= 4 or time.monotonic() + backoff > deadline:
+                    raise
+                time.sleep(backoff * (0.5 + random.random() * 0.5))
+                backoff = min(backoff * 2.0, 2.0)
 
     def notify(self, method: str, data: Any = None):
         self._maybe_reconnect()

@@ -3,14 +3,43 @@
 Parity: reference ``python/ray/_private/test_utils.py`` — ``NodeKillerActor
 :1400`` / ``kill_raylet:1741``: random fault injection used by the nightly
 chaos suite to prove lineage reconstruction + actor restart under fire.
+
+Two chaos planes compose here:
+- :func:`network_chaos` — message-level faults (drop/delay/dup/partition/
+  blackout) via ``_private/chaos.py``, seeded + deterministic.
+- :class:`ChaosKiller` — process-level faults (SIGKILL workers/raylets).
 """
 
 from __future__ import annotations
 
+import contextlib
+import json
+import os
 import random
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+from ray_tpu._private import chaos
+
+
+@contextlib.contextmanager
+def network_chaos(spec: Dict, role: str = "driver"):
+    """Export a chaos spec to the environment (inherited by every daemon
+    and worker a subsequently-started cluster spawns) AND install it in
+    this process; restores both on exit. Start the cluster INSIDE the
+    context or the daemons won't see the spec."""
+    old = os.environ.get(chaos.ENV_SPEC)
+    os.environ[chaos.ENV_SPEC] = json.dumps(spec)
+    plane = chaos.install(spec, role=role)
+    try:
+        yield plane
+    finally:
+        if old is None:
+            os.environ.pop(chaos.ENV_SPEC, None)
+        else:
+            os.environ[chaos.ENV_SPEC] = old
+        chaos.uninstall()
 
 
 class ChaosKiller:

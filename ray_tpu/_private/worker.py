@@ -55,6 +55,9 @@ def init(
         # submitted jobs (job_submission) and CLI tools join the running
         # cluster via RAYTPU_ADDRESS (parity: RAY_ADDRESS)
         address = os.environ.get("RAYTPU_ADDRESS") or None
+    from ray_tpu._private import chaos
+
+    chaos.install_from_env("driver")  # spec env inherited by all daemons
     GLOBAL_CONFIG.initialize(system_config)
     if object_store_memory:
         GLOBAL_CONFIG.load({"object_store_memory_bytes": int(object_store_memory)})
@@ -91,18 +94,44 @@ def init(
     }
 
 
+def _tpu_probe_cache_path() -> str:
+    import tempfile
+
+    base = os.path.join(tempfile.gettempdir(), "raytpu")
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, "tpu_probe.json")
+
+
 def _detect_tpu_chips() -> int:
     """Count accelerator devices, bounded in time: a wedged TPU tunnel
     makes ``jax.devices()`` block indefinitely inside PJRT client
     creation, and init() must degrade to CPU-only rather than hang the
     whole process (observed with the axon loopback relay; same failure
-    mode as an unreachable libtpu grpc endpoint on a real pod)."""
+    mode as an unreachable libtpu grpc endpoint on a real pod).
+
+    The result is CACHED in the sessions base dir (host-level, TTL
+    ``RAYTPU_TPU_DETECT_CACHE_TTL_S``, default 15 min, 0 disables): an
+    unhealthy host eats the ``RAYTPU_TPU_DETECT_TIMEOUT_S`` stall once,
+    not on every subsequent init()/prestart on the same box."""
+    import json
     import queue
+    import time as _time
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # explicitly pinned to CPU: never probe the accelerator plugin
         # (site hooks may override the pin and block on a dead tunnel)
         return 0
+
+    ttl = float(os.environ.get("RAYTPU_TPU_DETECT_CACHE_TTL_S", "900"))
+    cache_path = _tpu_probe_cache_path()
+    if ttl > 0:
+        try:
+            with open(cache_path) as f:
+                cached = json.load(f)
+            if _time.time() - float(cached["ts"]) < ttl:
+                return int(cached["chips"])
+        except Exception:
+            pass  # absent/corrupt cache: probe
 
     out: "queue.SimpleQueue" = queue.SimpleQueue()
 
@@ -122,9 +151,18 @@ def _detect_tpu_chips() -> int:
         timeout = float(os.environ.get(
             "RAYTPU_TPU_DETECT_TIMEOUT_S", "60"
         ))
-        return out.get(timeout=timeout)
+        chips = out.get(timeout=timeout)
     except Exception:  # queue.Empty: tunnel wedged — degrade to CPU
-        return 0
+        chips = 0
+    if ttl > 0:
+        try:
+            tmp = cache_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"chips": chips, "ts": _time.time()}, f)
+            os.replace(tmp, cache_path)
+        except Exception:
+            pass
+    return chips
 
 
 def connect(*, raylet_addr, gcs_addr, store_path, node_id, session_dir):

@@ -13,9 +13,11 @@ import sys
 
 
 def main():
+    from ray_tpu._private import chaos
     from ray_tpu._private.fate_share import fate_share_with_parent
 
     fate_share_with_parent()  # die with the raylet, not ~20s later
+    chaos.install_from_env("worker")
     p = argparse.ArgumentParser()
     p.add_argument("--raylet")
     p.add_argument("--gcs")

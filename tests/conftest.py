@@ -37,6 +37,11 @@ def pytest_configure(config):
         "slow: long-running scale/chaos tests (deselect with -m 'not slow' "
         "for the fast tier)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: network fault-injection tests (the bounded smoke variants "
+        "run in the default tier; full soaks are additionally marked slow)",
+    )
 
 
 @pytest.fixture

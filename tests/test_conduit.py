@@ -29,7 +29,8 @@ def engine():
 def _echo_server(eng, path):
     def on_accept(cid):
         def on_frame(c, payload):
-            kind, seq, method, data = msgpack.unpackb(payload, raw=False)
+            # requests may carry a 5th element (request id) — ignore it
+            kind, seq, method, data = msgpack.unpackb(payload, raw=False)[:4]
             eng.send(
                 c, msgpack.packb([1, seq, method, data], use_bin_type=True)
             )

@@ -223,6 +223,127 @@ def test_daemons_fate_share_with_driver(tmp_path):
     assert leftover == [], leftover
 
 
+def test_gcs_journal_replay_and_torn_tail(tmp_path):
+    """Journal framing round-trips; a torn tail (SIGKILL mid-append)
+    drops only the partial record."""
+    from ray_tpu._private.gcs import GcsJournal
+
+    p = str(tmp_path / "j")
+    j = GcsJournal(p)
+    j.append(["kv", "a", b"1"])
+    j.append(["kv", "b", b"2"])
+    j.append(["kv", "a", None])
+    j.close()
+    recs = list(GcsJournal.replay(p))
+    assert recs == [["kv", "a", b"1"], ["kv", "b", b"2"], ["kv", "a", None]]
+    with open(p, "ab") as f:
+        f.write((1000).to_bytes(4, "big") + b"short")
+    assert list(GcsJournal.replay(p)) == recs
+    assert list(GcsJournal.replay(str(tmp_path / "missing"))) == []
+
+
+def test_live_gcs_sigkill_no_flush_window():
+    """THE live-restart guarantee: with the snapshot interval pushed past
+    the test's lifetime, the mutation journal alone must carry actors,
+    named actors, placement groups, and KV across a GCS SIGKILL with no
+    flush window — and the raylet/driver reconnect (re-register +
+    resubscribe) without restarting."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        placement_group_table,
+    )
+
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 4}},
+        system_config={
+            "gcs_storage_backend": "file",
+            "gcs_snapshot_interval_s": 3600.0,  # snapshots never fire
+        },
+        use_tcp=True,
+    )
+    c.connect()
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        gcs = global_worker.core_worker.gcs
+        gcs.call("kv_put", ["journal_key", b"alive", True])
+
+        @ray_tpu.remote(name="journal_survivor")
+        class K:
+            def __init__(self):
+                self.v = 0
+
+            def bump(self):
+                self.v += 1
+                return self.v
+
+        a = K.remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(timeout_seconds=60)
+
+        # SIGKILL + restart immediately: no persistence flush window
+        c._impl.restart_gcs()
+
+        # KV restored purely from journal replay
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                v = gcs.call("kv_get", "journal_key", timeout=5)
+                if v is not None:
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "KV lost / never reconnected"
+            time.sleep(0.2)
+        assert bytes(v) == b"alive"
+
+        # placement-group table restored (state + assignment)
+        rec = pg.table()
+        assert rec is not None and rec["state"] == "CREATED"
+        assert all(n is not None for n in rec["assignment"])
+        assert placement_group_table()
+
+        # named actor reclaimed by the re-registering raylet, state intact
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                h = ray_tpu.get_actor("journal_survivor")
+                assert ray_tpu.get(h.bump.remote(), timeout=30) == 2
+                break
+            except Exception:
+                assert time.monotonic() < deadline, (
+                    "named actor lost after live GCS SIGKILL"
+                )
+                time.sleep(0.3)
+        # the original handle keeps working too (worker never died)
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 3
+
+        # raylet re-registered WITHOUT restarting, and resubscribed its
+        # pubsub channels; journaling is live again on the new GCS
+        # (poll: the actor checks above can win via the driver's cached
+        # actor address before the raylet finishes re-registering)
+        deadline = time.monotonic() + 30
+        while True:
+            state = gcs.call("internal_state", None, timeout=10)
+            if state["num_nodes"] == 1 and state["subs"].get("nodes"):
+                break
+            assert time.monotonic() < deadline, state
+            time.sleep(0.3)
+        assert state["subs"].get("resources")
+        assert state["journal_appended"] is not None
+
+        @ray_tpu.remote
+        def ping(x):
+            return x + 1
+
+        assert ray_tpu.get(ping.remote(41), timeout=120) == 42
+    finally:
+        c.shutdown()
+
+
 def test_gcs_snapshot_fsync_policy(tmp_path, monkeypatch):
     """VERDICT r3 weak #9: the file backend's snapshot interval and
     fsync policy are configurable; fsync'd snapshots still round-trip."""
@@ -245,6 +366,10 @@ def test_gcs_snapshot_fsync_policy(tmp_path, monkeypatch):
     srv2.storage_path = path
     srv2.kv = {}
     srv2.jobs = {}
+    srv2.actors = {}
+    srv2.named_actors = {}
+    srv2.placement_groups = {}
+    srv2._recovering = set()
     srv2._load_storage()
     assert srv2.kv == {b"k": b"v"}
     assert srv2.jobs["j1"]["status"] == "SUCCEEDED"
