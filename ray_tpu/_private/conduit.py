@@ -38,6 +38,8 @@ _build_lock = threading.Lock()
 EV_FRAME = 0
 EV_ACCEPTED = 1
 EV_CLOSED = 2
+EV_SENT = 4
+EV_RAW = 5
 
 
 class _CdEvent(ctypes.Structure):
@@ -90,6 +92,22 @@ def load():
                 ctypes.c_char_p, ctypes.c_uint32,
             ]
             lib.cd_send.restype = ctypes.c_int64
+            lib.cd_send_iov.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_int32, ctypes.c_int64,
+            ]
+            lib.cd_send_iov.restype = ctypes.c_int64
+            lib.cd_sink_register.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_uint64,
+            ]
+            lib.cd_sink_register.restype = ctypes.c_int
+            lib.cd_sink_unregister.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.cd_sink_unregister.restype = ctypes.c_int
             lib.cd_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
             lib.cd_poll.argtypes = [
                 ctypes.c_void_p, ctypes.c_int,
@@ -149,9 +167,18 @@ class Engine:
         self.lib.cd_set_ev_high_water(self.h, int(hwm) * 1024 * 1024)
         self._cb_lock = threading.Lock()
         self._on_frame: Dict[int, Callable] = {}
+        self._on_raw: Dict[int, Callable] = {}
         self._on_close: Dict[int, Callable] = {}
         self._on_accept: Dict[int, Callable] = {}
         self._orphans: Dict[int, list] = {}  # frames pre-registration
+        # zero-copy sends in flight: token -> (on_sent cb | None, refs...)
+        # The entry holds a reference to the payload object so the memory
+        # cd_send_iov handed to C stays alive until EV_SENT.
+        self._tok_lock = threading.Lock()
+        self._next_token = 1
+        self._inflight_sends: Dict[int, tuple] = {}
+        # deposit regions pinned while registered (token -> buffer refs)
+        self._sink_refs: Dict[int, tuple] = {}
         self._stopped = False
         self._evbuf = (_CdEvent * self.POLL_BATCH)()
         self._reaper = threading.Thread(
@@ -174,14 +201,20 @@ class Engine:
             inst.stop()
 
     # ---- registration ----
-    def register(self, conn_id: int, on_frame, on_close=None):
+    def register(self, conn_id: int, on_frame, on_close=None, on_raw=None):
         with self._cb_lock:
             self._on_frame[conn_id] = on_frame
             if on_close is not None:
                 self._on_close[conn_id] = on_close
+            if on_raw is not None:
+                self._on_raw[conn_id] = on_raw
             backlog = self._orphans.pop(conn_id, [])
-        for payload in backlog:
-            on_frame(conn_id, payload)
+        for raw, payload, aux in backlog:
+            if raw:
+                if on_raw is not None:
+                    on_raw(conn_id, memoryview(payload), aux)
+            else:
+                on_frame(conn_id, payload)
 
     def listen(self, addr: str, on_accept) -> str:
         """Returns the bound address (tcp port 0 resolved)."""
@@ -212,6 +245,64 @@ class Engine:
             raise ConnectionError(f"conduit conn {conn_id} closed")
         return n
 
+    def send_iov(self, conn_id: int, header: bytes, payload,
+                 raw: bool = True, on_sent: Optional[Callable] = None) -> int:
+        """Scatter-gather send: `header` is copied (small), `payload` —
+        any buffer object, typically a memoryview over the shm object
+        store — is written by the engine's writev STRAIGHT from its
+        memory: no Python-level copy, no msgpack encode of the bulk
+        bytes. The engine holds a reference to `payload` until the bytes
+        hit the socket (or the conn dies), then invokes `on_sent()` on
+        the reaper thread. With raw=True the frame goes out with the
+        RAW length-word marker (EV_RAW on a conduit receiver)."""
+        import numpy as np
+
+        # np.frombuffer gives a zero-copy address for read-only buffers
+        # too (ctypes.from_buffer demands writable memory).
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        with self._tok_lock:
+            token = self._next_token
+            self._next_token += 1
+            self._inflight_sends[token] = (on_sent, payload, arr)
+        n = self.lib.cd_send_iov(
+            self.h, conn_id, header, len(header),
+            ctypes.c_void_p(arr.ctypes.data), arr.nbytes,
+            1 if raw else 0, token,
+        )
+        if n < 0:
+            with self._tok_lock:
+                self._inflight_sends.pop(token, None)
+            if n == -2:
+                raise ValueError("frame exceeds 1 GiB cap")
+            raise ConnectionError(f"conduit conn {conn_id} closed")
+        return n
+
+    def sink_register(self, token: int, buf) -> None:
+        """Register a deposit region: raw frames carrying ``token``
+        stream their payload straight off the socket into ``buf`` (a
+        WRITABLE buffer, e.g. an object-store create buffer) at the
+        frame's deposit offset — receive-into-place with the kernel's
+        recv copy as the only receive-side copy. The engine holds a
+        reference to ``buf`` until :meth:`sink_unregister`."""
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        rc = self.lib.cd_sink_register(
+            self.h, token, ctypes.c_void_p(arr.ctypes.data), arr.nbytes
+        )
+        if rc != 0:
+            raise ValueError(f"sink token {token} already registered")
+        with self._tok_lock:
+            self._sink_refs[token] = (buf, arr)
+
+    def sink_unregister(self, token: int) -> None:
+        """Unregister a deposit region. Blocks until any in-flight
+        engine write into it completes — on return the buffer can be
+        sealed/aborted/freed race-free; late frames are discarded."""
+        self.lib.cd_sink_unregister(self.h, token)
+        with self._tok_lock:
+            self._sink_refs.pop(token, None)
+
     def close(self, conn_id: int):
         self.lib.cd_close(self.h, conn_id)
 
@@ -241,7 +332,7 @@ class Engine:
                         cb = self._on_frame.get(ev.conn)
                         if cb is None:
                             self._orphans.setdefault(ev.conn, []).append(
-                                payload
+                                (False, payload, 0)
                             )
                             continue
                     try:
@@ -250,6 +341,46 @@ class Engine:
                         import traceback
 
                         traceback.print_exc()
+                elif ev.kind == EV_RAW:
+                    # Raw frame body ([u32 hlen][u64 token][u64 off]
+                    # [header][payload]) as a ZERO-COPY view over the
+                    # native buffer; for deposit frames (token != 0) the
+                    # payload already streamed into the registered sink
+                    # and ev.aux carries the deposited byte count (-1 =
+                    # discarded). The body is freed when the callback
+                    # returns.
+                    with self._cb_lock:
+                        rcb = self._on_raw.get(ev.conn)
+                        if rcb is None:
+                            self._orphans.setdefault(ev.conn, []).append(
+                                (True, ctypes.string_at(ev.data, ev.len),
+                                 ev.aux)
+                            )
+                            lib.cd_free(h, ev.data)
+                            continue
+                    addr = ctypes.cast(ev.data, ctypes.c_void_p).value
+                    body = memoryview(
+                        (ctypes.c_ubyte * ev.len).from_address(addr)
+                    ).cast("B").toreadonly()
+                    try:
+                        rcb(ev.conn, body, ev.aux)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                    finally:
+                        body.release()
+                        lib.cd_free(h, ev.data)
+                elif ev.kind == EV_SENT:
+                    with self._tok_lock:
+                        ent = self._inflight_sends.pop(ev.aux, None)
+                    if ent is not None and ent[0] is not None:
+                        try:
+                            ent[0]()
+                        except Exception:
+                            import traceback
+
+                            traceback.print_exc()
                 elif ev.kind == EV_ACCEPTED:
                     with self._cb_lock:
                         acb = self._on_accept.get(ev.aux)
@@ -263,6 +394,7 @@ class Engine:
                 elif ev.kind == EV_CLOSED:
                     with self._cb_lock:
                         self._on_frame.pop(ev.conn, None)
+                        self._on_raw.pop(ev.conn, None)
                         ccb = self._on_close.pop(ev.conn, None)
                         self._orphans.pop(ev.conn, None)
                     if ccb is not None:

@@ -113,18 +113,30 @@ class OrderGate:
 
 
 class ConduitConnection:
-    """Inbound conduit connection duck-typing rpc.Connection for the
-    handler table (call_async / notify_async / add_close_callback /
-    closed / arbitrary attributes like the push-order gate)."""
+    """A conduit connection duck-typing rpc.Connection for the handler
+    table (call_async / notify_async / add_close_callback / closed /
+    arbitrary attributes like the push-order gate). Serves both inbound
+    (accepted by ConduitRpcServer) and outbound (``connect_conduit``)
+    directions — the frame protocol is symmetric."""
 
-    def __init__(self, server: "ConduitRpcServer", conn_id: int):
+    def __init__(self, engine, conn_id: int, loop, name: str,
+                 handler=None, fast_dispatch=None,
+                 server: Optional["ConduitRpcServer"] = None):
         self.server = server
-        self.engine = server.engine
+        self.engine = engine
         self.conn_id = conn_id
-        self.loop = server.loop
-        self.name = f"{server.name}#{conn_id}"
+        self.loop = loop
+        self.name = name
+        self.handler = handler
+        self.fast_dispatch = fast_dispatch
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        # seqno -> sink for in-flight call_raw_async: invoked on the
+        # REAPER thread so the payload copies straight from the native
+        # frame body into its destination (receive-into-place)
+        self._raw_sinks: Dict[int, object] = {}
+        # method -> fn(conn, meta, payload_view): inbound raw notifies
+        self.raw_notify: Dict[str, object] = {}
         self._closed = False
         self._close_callbacks: List = []
         self.order_gate: Optional[OrderGate] = None  # lazily by fast path
@@ -171,6 +183,60 @@ class ConduitConnection:
                 self.engine.send(self.conn_id, body)
             except ConnectionError:
                 return  # conn died while the frame was "in flight"
+
+    def send_raw_frame(self, kind, seqno, method, meta, payload,
+                       on_sent=None, token=0, off=0):
+        """Queue one RAW frame: small msgpack header + bulk payload sent
+        zero-copy (writev straight from the payload buffer — typically a
+        memoryview over the shm object store). ``on_sent`` fires exactly
+        once when the engine no longer references the payload.
+        ``token``/``off`` address a deposit sink on the receiver (0 =
+        inline). Safe from any thread."""
+        hdr = msgpack.packb([kind, seqno, method, meta], use_bin_type=True)
+        header = (
+            len(hdr).to_bytes(4, "big")
+            + int(token).to_bytes(8, "big")
+            + int(off).to_bytes(8, "big")
+            + hdr
+        )
+        pl = _chaos._PLANE
+        if pl is not None:
+            link = self.name + (
+                "|" + self.chaos_peer if self.chaos_peer else ""
+            )
+            copies, delay = pl.decide(link, next(self._chaos_seq))
+            if copies == 0:
+                if on_sent is not None:
+                    on_sent()  # dropped: the buffer is no longer needed
+                return
+            if delay > 0:
+                # chaos mode: materialize the payload (its pin may be
+                # released before the timer fires) and send later
+                data = bytes(payload)
+                t = threading.Timer(
+                    delay, self._send_iov_copies, args=(header, data, copies)
+                )
+                t.daemon = True
+                t.start()
+                if on_sent is not None:
+                    on_sent()
+                return
+            if copies > 1:
+                self._send_iov_copies(header, bytes(payload), copies - 1)
+        try:
+            self.engine.send_iov(self.conn_id, header, payload,
+                                 raw=True, on_sent=on_sent)
+        except Exception:
+            if on_sent is not None:
+                on_sent()
+            raise
+
+    def _send_iov_copies(self, header: bytes, data: bytes, copies: int):
+        for _ in range(copies):
+            try:
+                self.engine.send_iov(self.conn_id, header, data, raw=True)
+            except Exception:
+                return
 
     def reply_fn(self, seqno, method) -> Callable[[dict], None]:
         """Thread-safe completion callback: the exec thread replies
@@ -240,6 +306,26 @@ class ConduitConnection:
         finally:
             self._pending.pop(seqno, None)
 
+    async def call_raw_async(self, method, data, sink, timeout=None):
+        """Request whose reply arrives as a RAW frame: ``sink(meta,
+        payload_view)`` runs on the reaper thread — copy the payload into
+        its destination there (receive-into-place) — and the call
+        returns ``meta``. A normal (msgpack) error reply raises."""
+        seqno = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seqno] = fut
+        self._raw_sinks[seqno] = sink
+        try:
+            if self._closed:
+                raise rpc.SendError(f"connection {self.name} closed")
+            self.send_frame(rpc._REQUEST, seqno, method, data)
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(seqno, None)
+            self._raw_sinks.pop(seqno, None)
+
     async def notify_async(self, method, data):
         self.send_frame(rpc._NOTIFY, None, method, data)
 
@@ -280,12 +366,67 @@ class ConduitConnection:
         if kind in (rpc._REPLY, rpc._ERROR):
             self.loop.call_soon_threadsafe(self._resolve, kind, seqno, data)
             return
-        fast = self.server.fast_dispatch
+        fast = self.fast_dispatch
         if fast is not None and fast(self, kind, seqno, method, data):
             return
         self.loop.call_soon_threadsafe(
             self._spawn_handler, kind, seqno, method, data, rid
         )
+
+    def on_raw(self, body: memoryview, deposited: int = 0):
+        """One RAW frame — reaper thread. For deposit frames (token !=
+        0) the engine already streamed the payload into the registered
+        sink and ``body`` is just the header region (``deposited`` =
+        byte count, -1 = discarded). For inline frames the payload view
+        dies when this returns: sinks copy it into their destination
+        buffer here."""
+        hlen = int.from_bytes(body[:4], "big")
+        token = int.from_bytes(body[4:12], "big")
+        header = msgpack.unpackb(
+            bytes(body[20 : 20 + hlen]), raw=False
+        )
+        kind, seqno, method, meta = (
+            header[0], header[1], header[2], header[3]
+        )
+        payload = body[20 + hlen :]
+        if kind == rpc._REPLY:
+            err = None
+            if token != 0:
+                # deposited natively (or discarded: late frame after the
+                # sink unregistered, e.g. an aborted pull — fail the call)
+                self._raw_sinks.pop(seqno, None)
+                if deposited is None or deposited < 0:
+                    err = ConnectionError("raw deposit discarded")
+            else:
+                sink = self._raw_sinks.pop(seqno, None)
+                if sink is not None:
+                    try:
+                        sink(meta, payload)
+                    except Exception as e:  # surface to the caller
+                        err = e
+            self.loop.call_soon_threadsafe(
+                self._resolve_raw, seqno, meta, err
+            )
+        elif kind == rpc._NOTIFY:
+            fn = self.raw_notify.get(method)
+            if fn is not None:
+                try:
+                    # deposit frames (token != 0): payload already
+                    # streamed into the registered sink natively
+                    fn(self, meta, payload, token,
+                       deposited if token != 0 else None)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _resolve_raw(self, seqno, meta, err):
+        fut = self._pending.pop(seqno, None)
+        if fut is not None and not fut.done():
+            if err is None:
+                fut.set_result(meta)
+            else:
+                fut.set_exception(err)
 
     def _resolve(self, kind, seqno, data):
         fut = self._pending.pop(seqno, None)
@@ -301,13 +442,23 @@ class ConduitConnection:
     async def _handle(self, kind, seqno, method, data, rid=None):
         t0 = time.monotonic()
         out_kind, payload = await rpc.run_idempotent(
-            rid, lambda: self.server.handler(self, method, data)
+            rid, lambda: self.handler(self, method, data)
         )
         if out_kind == rpc._REPLY:
             rpc.method_stats().record(
                 method, (time.monotonic() - t0) * 1e3
             )
         if kind == rpc._REQUEST:
+            if out_kind == rpc._REPLY and isinstance(payload, rpc.RawReply):
+                try:
+                    self.send_raw_frame(
+                        rpc._REPLY, seqno, method, payload.meta,
+                        payload.payload, on_sent=payload.fire_sent,
+                        token=payload.token, off=payload.off,
+                    )
+                except Exception:
+                    pass  # send_raw_frame fired on_sent before raising
+                return
             try:
                 self.send_frame(out_kind, seqno, method, payload)
             except Exception:
@@ -331,6 +482,29 @@ class ConduitConnection:
                     pass
 
         self.loop.call_soon_threadsafe(run_cbs)
+
+
+async def connect_conduit(addr: str, handler=None, name: str = ""):
+    """Outbound conduit connection (rpc.Connection drop-in): the native
+    engine owns the socket, so calls AND raw-frame replies ride the
+    epoll/writev path — the raylet's peer-to-peer object transfers use
+    this when the native wire is enabled. The blocking connect runs off
+    the loop."""
+    if ":" not in addr or addr.startswith("/"):
+        addr = "unix:" + addr
+    loop = asyncio.get_running_loop()
+    engine = conduit.Engine.get()
+    conn_id = await loop.run_in_executor(None, engine.connect, addr)
+    conn = ConduitConnection(
+        engine, conn_id, loop, name or f"conduit->{addr}",
+        handler=handler or rpc._null_handler,
+    )
+    engine.register(
+        conn_id, lambda _cid, payload: conn.on_frame(payload),
+        on_close=lambda _cid: conn.on_engine_close(),
+        on_raw=lambda _cid, body, aux: conn.on_raw(body, aux),
+    )
+    return conn
 
 
 def make_server(addr: str, handler, name: str = "", fast_dispatch=None):
@@ -380,7 +554,11 @@ class ConduitRpcServer:
         self.addr = self.engine.listen(self.requested_addr, self._on_accept)
 
     def _on_accept(self, conn_id: int):  # reaper thread
-        conn = ConduitConnection(self, conn_id)
+        conn = ConduitConnection(
+            self.engine, conn_id, self.loop, f"{self.name}#{conn_id}",
+            handler=self.handler, fast_dispatch=self.fast_dispatch,
+            server=self,
+        )
         self.connections.append(conn)
         conn.add_close_callback(
             lambda c: self.connections.remove(c)
@@ -389,6 +567,7 @@ class ConduitRpcServer:
         self.engine.register(
             conn_id, lambda _cid, payload: conn.on_frame(payload),
             on_close=lambda _cid: conn.on_engine_close(),
+            on_raw=lambda _cid, body, aux: conn.on_raw(body, aux),
         )
 
     async def stop_async(self):

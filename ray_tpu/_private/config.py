@@ -100,8 +100,30 @@ _d("worker_pool_min_idle", int, 0)
 _d("scheduler_spread_threshold", float, 0.5)
 _d("infeasible_task_grace_s", float, 30.0)
 _d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
-# outbound chunk-serve concurrency per raylet (push-manager pacing role)
-_d("object_transfer_max_concurrent_chunks", int, 4)
+# outbound chunk-serve concurrency per raylet: bounds chunk payloads
+# pinned in flight on the send side (zero-copy sends hold their store
+# pin until the bytes hit the socket) — push-manager pacing role
+_d("object_transfer_max_concurrent_chunks", int, 16)
+# windowed pipelining: chunk requests a puller keeps in flight PER PEER
+# (bandwidth is window*chunk per RTT instead of one chunk per RTT)
+_d("object_transfer_window", int, 8)
+# multi-peer striping: a pull fetches disjoint chunk ranges from up to
+# this many location-holding raylets concurrently
+_d("object_transfer_stripe_peers", int, 3)
+# per-chunk-request timeout inside a windowed pull (covers queueing
+# behind the window, not just the wire RTT)
+_d("object_transfer_chunk_timeout_s", float, 30.0)
+# same-peer retries per chunk before the peer is declared failed and its
+# ranges hand over to the other stripe peers (a chaos-dropped frame
+# costs one chunk timeout, not the whole striped attempt)
+_d("object_transfer_chunk_retries", int, 2)
+# full pull attempts (fresh locations + striped fetch) before giving up
+_d("object_transfer_retries", int, 3)
+# same-host fast path: when a LIVE peer raylet's store arena is
+# reachable as a file (multi-raylet hosts, simulated clusters), pull by
+# attaching it and copying arena-to-arena — no sockets (the reference
+# shares plasma objects between same-node workers the same way)
+_d("object_transfer_same_host_shm", bool, True)
 # how many tasks an owner keeps in flight per lease. DEFAULT 1: a task
 # blocked in a nested get() must not strand tasks committed behind it on
 # the same serial worker (they would get their own leases instead).

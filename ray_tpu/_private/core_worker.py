@@ -884,7 +884,9 @@ class CoreWorker:
 
     def _read_plasma(self, ref: ObjectRef, requested_pull, wake=None,
                      listening=None):
-        view = self.store.get(ref.id, timeout=0)
+        # writable=True: the pre-3.12 pin carrier (ctypes.from_buffer) needs
+        # a writable source; unpack() re-wraps every consumer view read-only.
+        view = self.store.get(ref.id, timeout=0, writable=True)
         if view is not None:
             # The store ref taken by get() is owned by `pin`: it lives until
             # every zero-copy view deserialized from the buffer dies, so LRU

@@ -170,7 +170,12 @@ class Cluster:
             return self._gcs_addr
         return "unix:" + self.gcs_sock
 
-    def start_gcs(self, system_config: Optional[Dict] = None):
+    def start_gcs(self, system_config: Optional[Dict] = None,
+                  wait: bool = True):
+        """``wait=False`` returns right after the spawn: every client
+        (raylet registration, driver CoreWorker) connect-retries while the
+        GCS binds, so a head-node boot can overlap the GCS and raylet
+        process startups instead of serializing them."""
         if self._gcs_addr is not None:
             raise RuntimeError("joined an external GCS; not starting one")
         if self.use_tcp:
@@ -190,7 +195,8 @@ class Cluster:
             self._gcs_cmd,
             os.path.join(self.session_dir, "logs", "gcs.log"),
         )
-        _wait_addr(self.gcs_addr, proc=self.gcs_proc)
+        if wait:
+            _wait_addr(self.gcs_addr, proc=self.gcs_proc)
 
     def restart_gcs(self):
         """Kill + restart the GCS process (FT testing: with the file storage

@@ -181,9 +181,16 @@ class SharedMemoryStore:
         self.seal(oid)
         self.release(oid)
 
-    def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
+    def get(self, oid: ObjectID, timeout: Optional[float] = 0,
+            writable: bool = False) -> Optional[memoryview]:
         """Returns a zero-copy view (caller must release(oid) when done), or
-        None if not present within timeout."""
+        None if not present within timeout.
+
+        ``writable=True`` is for the deserializer's pin path only (pre-3.12
+        ``ctypes.from_buffer`` pin carriers need a writable source; the view
+        handed to consumers is re-wrapped read-only) — sealed objects stay
+        immutable from the caller's perspective.
+        """
         if not self._base:
             return None
         size = ctypes.c_uint64()
@@ -192,8 +199,9 @@ class SharedMemoryStore:
         )
         if off < 0:
             return None
+        view = self._view[off : off + size.value]
         # Sealed objects are immutable: hand out a read-only view.
-        return self._view[off : off + size.value].toreadonly()
+        return view if writable else view.toreadonly()
 
     def release(self, oid: ObjectID):
         # After close() the arena is detached; outstanding pins (zero-copy

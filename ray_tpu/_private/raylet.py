@@ -20,6 +20,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -30,6 +31,135 @@ from ray_tpu._private.object_store import SharedMemoryStore
 from ray_tpu._private.protocol import NodeInfo
 
 logger = logging.getLogger(__name__)
+
+
+class _PullSink:
+    """Write-into-place target + arrival ledger for one striped pull.
+
+    Chunk frames land from transport threads (conduit reaper / IO loop):
+    inline payloads copy straight into the store buffer here, native
+    deposits just record. The lock serializes writes against the abort
+    path, so a straggler chunk can never land in a freed store slot."""
+
+    __slots__ = ("_buf", "_lock", "closed", "landed")
+
+    def __init__(self, buf):
+        self._buf = buf
+        self._lock = threading.Lock()
+        self.closed = False
+        self.landed: Dict[int, int] = {}  # chunk off -> bytes landed
+
+    def write(self, off: int, mv) -> bool:
+        """Copy one chunk payload straight into the store buffer (the
+        only Python-side copy the receive path makes). False once
+        closed."""
+        with self._lock:
+            if self.closed:
+                return False
+            self._buf[off : off + len(mv)] = mv
+            return True
+
+    def record(self, off: int, n: int):
+        with self._lock:
+            if not self.closed:
+                self.landed[off] = n
+
+    def close(self):
+        """Stop accepting writes and drop the buffer reference (called
+        before seal/abort; blocks on any in-flight chunk write)."""
+        with self._lock:
+            self.closed = True
+            self._buf = None
+
+
+class _PeerEntry:
+    __slots__ = ("conn", "users")
+
+    def __init__(self, conn):
+        self.conn = conn
+        self.users = 0
+
+
+class PeerConnectionPool:
+    """Pooled persistent connections to peer raylets for the object
+    plane (parity: the reference ObjectManager's connection pool,
+    object_manager.h:117) — replaces per-fetch open/close. One
+    multiplexed connection per peer address; transport errors discard
+    the entry so the next acquire re-dials."""
+
+    def __init__(self, name: str = "raylet-pull"):
+        self.name = name
+        self._conns: Dict[str, _PeerEntry] = {}
+        self._dial_locks: Dict[str, asyncio.Lock] = {}
+
+    async def acquire(self, addr: str):
+        while True:
+            ent = self._conns.get(addr)
+            if ent is not None and not ent.conn.closed:
+                ent.users += 1
+                return ent.conn
+            lock = self._dial_locks.setdefault(addr, asyncio.Lock())
+            async with lock:
+                ent = self._conns.get(addr)
+                if ent is not None and not ent.conn.closed:
+                    continue  # a concurrent dial won; retake fast path
+                conn = await self._dial(addr)
+                ent = _PeerEntry(conn)
+                ent.users = 1
+                self._conns[addr] = ent
+                conn.add_close_callback(
+                    lambda c, a=addr: self._on_conn_close(a, c)
+                )
+                return conn
+
+    def release(self, addr: str, conn, discard: bool = False):
+        ent = self._conns.get(addr)
+        if ent is not None and ent.conn is conn:
+            ent.users = max(0, ent.users - 1)
+            if discard:
+                self._conns.pop(addr, None)
+        if discard:
+            try:
+                conn._do_close()
+            except Exception:
+                pass
+
+    def _on_conn_close(self, addr: str, conn):
+        ent = self._conns.get(addr)
+        if ent is not None and ent.conn is conn:
+            self._conns.pop(addr, None)
+
+    async def _dial(self, addr: str):
+        from ray_tpu._private import conduit
+
+        # Per-dial nonce in the link name: each (re)connection is a NEW
+        # chaos link with its own deterministic fault schedule — without
+        # it, a seed whose schedule drops frame 0 of "raylet-pull|addr"
+        # would drop the first frame of EVERY re-dialed conn, turning a
+        # probabilistic fault into a permanent one.
+        name = f"{self.name}#{os.urandom(2).hex()}"
+        if GLOBAL_CONFIG.native_wire and conduit.available():
+            from ray_tpu._private.conduit_rpc import connect_conduit
+
+            conn = await connect_conduit(addr, name=name)
+        else:
+            conn = await rpc.connect_async(addr, timeout=10, name=name)
+        # chaos-plane link identity: lets fault rules target the pull
+        # link of ONE peer ("raylet-pull|<addr>") or all of them
+        conn.chaos_peer = addr
+        return conn
+
+    def stats(self) -> Dict[str, int]:
+        live = [e for e in self._conns.values() if not e.conn.closed]
+        return {"open": len(live), "in_use": sum(e.users for e in live)}
+
+    def close_all(self):
+        for ent in list(self._conns.values()):
+            try:
+                ent.conn._do_close()
+            except Exception:
+                pass
+        self._conns.clear()
 
 
 class WorkerHandle:
@@ -132,13 +262,27 @@ class Raylet:
         self._spilling: Set[bytes] = set()  # oids with an in-flight spill
         self._ever_workers: Set[bytes] = set()  # for log tailing after death
         # object-plane transfer management (dependency-manager round):
-        # in-flight inbound pulls (dedup) + outbound chunk pacing + counters
+        # in-flight inbound pulls (dedup) + outbound chunk pacing + pooled
+        # persistent peer connections + throughput counters
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         self._outbound_sem = asyncio.Semaphore(
             int(GLOBAL_CONFIG.object_transfer_max_concurrent_chunks)
         )
         self._outbound_chunks = 0
         self._objects_served = 0
+        self._peer_pool = PeerConnectionPool()
+        # same-host fast path: attached peer store arenas by path
+        self._peer_stores: Dict[str, SharedMemoryStore] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._transfer_bytes_in = 0
+        self._transfer_bytes_out = 0
+        self._last_pull_gbps = 0.0
+        self._pull_chunks_inflight = 0
+        self._pull_aborts = 0
+        self._transfer_chunk_retries = 0
+        # live inbound transfers: deposit token -> _PullSink (chunk
+        # frames route to their transfer by the token they carry)
+        self._transfers: Dict[int, _PullSink] = {}
         # live actors hosted here: actor_id -> {"spec", "address"} — replayed
         # to a restarted GCS so its actor table survives (GCS FT)
         self.hosted_actors: Dict[bytes, Dict] = {}
@@ -147,6 +291,7 @@ class Raylet:
 
     # ------------- lifecycle -------------
     async def start(self):
+        self._loop = asyncio.get_running_loop()
         size = int(GLOBAL_CONFIG.object_store_memory_bytes)
         self.store = SharedMemoryStore.create(self.store_path, size)
         if GLOBAL_CONFIG.object_spilling_enabled:
@@ -172,6 +317,12 @@ class Raylet:
         for w in self.workers.values():
             if w.proc is not None and w.proc.poll() is None:
                 w.proc.terminate()
+        self._peer_pool.close_all()
+        for st in self._peer_stores.values():
+            try:
+                st.close()
+            except Exception:
+                pass
         await self.server.stop_async()
         if self.store is not None:
             self.store.close()
@@ -1035,7 +1186,7 @@ class Raylet:
         # never recovered ahead of the killer.
         for kind, n in kind_deficit.items():
             for _ in range(min(n, 32)):
-                self._maybe_spawn_worker(kind)
+                self._maybe_spawn_worker(kind, deficit=n)
 
     def _pop_idle_worker(self, tpu: bool = False) -> Optional[WorkerHandle]:
         for i in range(len(self.idle) - 1, -1, -1):
@@ -1047,7 +1198,7 @@ class Raylet:
                 return w
         return None
 
-    def _maybe_spawn_worker(self, tpu: bool = False):
+    def _maybe_spawn_worker(self, tpu: bool = False, deficit: int = 1 << 30):
         # One pending spawn per queued request, bounded by CPU slots — but
         # the cap governs TASK-serving workers only: actors hold dedicated
         # workers for life (reference semantics) and are admission-limited
@@ -1056,10 +1207,25 @@ class Raylet:
         # Count only the REQUESTED flavor (tpu-env vs clean-env): idle
         # workers of the other flavor must not starve this request (they
         # can't serve it — _pop_idle_worker is flavor-matched).
+        # A worker that died before announcing (spawn crash, OOM kill) must
+        # not count as "starting" forever — purge it so the pool respawns.
+        dead_boot = [
+            wid for wid, w in self.workers.items()
+            if not w.registered.is_set() and w.proc is not None
+            and w.proc.poll() is not None
+        ]
+        for wid in dead_boot:
+            self.workers.pop(wid, None)
         starting = sum(
             1 for w in self.workers.values()
             if not w.registered.is_set() and w.tpu == tpu
         )
+        # Workers already booting will serve the queue when they announce:
+        # spawning past the unsatisfied-queue depth just makes N python
+        # interpreters contend for the same cores during startup (worst on
+        # small hosts, where it doubles time-to-first-task).
+        if starting >= deficit:
+            return
         busy_tasks = sum(
             1 for lease in self.leases.values()
             if lease.worker.actor_id is None and lease.worker.tpu == tpu
@@ -1466,104 +1632,562 @@ class Raylet:
             self._pulls_inflight.pop(oid_bytes, None)
 
     async def _pull_object_once(self, oid, oid_bytes: bytes) -> bool:
+        """One logical pull: locate holders, probe their metas, then run
+        a windowed multi-peer striped fetch. A failed attempt (peer died
+        or timed out mid-pull) aborts the partial buffer ONCE and retries
+        with fresh locations up to ``object_transfer_retries`` times."""
         import random as _random
 
-        locs = await self.gcs.call_async("get_object_locations", oid_bytes)
-        locs = list(locs)
-        # randomize the source so an N-node broadcast forms a tree (each
-        # completed pull registers a new location) instead of every node
-        # hammering the origin (reference push_manager.h:30 role)
-        _random.shuffle(locs)
-        for node_id in locs:
-            nid_hex = bytes(node_id).hex()
-            if nid_hex == self.node_id.hex():
-                continue
-            node = self.cluster_nodes.get(nid_hex)
-            if node is None or not node.get("alive", True):
-                continue
-            ok = await self._fetch_from_node(oid, node["raylet_addr"])
-            if ok:
+        retries = max(1, int(GLOBAL_CONFIG.object_transfer_retries))
+        stripe = max(1, int(GLOBAL_CONFIG.object_transfer_stripe_peers))
+        trace = os.environ.get("RAYTPU_TRANSFER_TRACE")
+        for attempt in range(retries):
+            t_loc = time.perf_counter()
+            if self.store.contains(oid):
                 return True
+            locs = await self.gcs.call_async(
+                "get_object_locations", oid_bytes
+            )
+            cands = []
+            for node_id in locs:
+                nid_hex = bytes(node_id).hex()
+                if nid_hex == self.node_id.hex():
+                    continue
+                node = self.cluster_nodes.get(nid_hex)
+                if node is None or not node.get("alive", True):
+                    continue
+                cands.append(node)
+            if not cands:
+                return False
+            # randomize the source order so an N-node broadcast forms a
+            # tree (each completed pull registers a new location) instead
+            # of every node hammering the origin (push_manager.h:30 role)
+            _random.shuffle(cands)
+            if GLOBAL_CONFIG.object_transfer_same_host_shm:
+                for node in cands:
+                    if await self._pull_same_host_shm(oid, node):
+                        return True
+            addrs = [n["raylet_addr"] for n in cands]
+            probe_n = min(len(addrs), max(stripe, 2))
+            t_meta = time.perf_counter()
+            metas = await asyncio.gather(
+                *[self._peer_meta(a, oid) for a in addrs[:probe_n]]
+            )
+            if trace:
+                logger.info("pull %s: locations=%.3fs metas=%.3fs",
+                            oid.hex()[:12], t_meta - t_loc,
+                            time.perf_counter() - t_meta)
+            sources = [
+                (a, m) for a, m in zip(addrs, metas) if m is not None
+            ]
+            # prefer in-memory copies over spill-restoring peers: stable
+            # sort keeps the shuffled tree order within each class
+            sources.sort(key=lambda am: bool(am[1].get("spilled")))
+            if not sources:
+                for a in addrs[probe_n:]:
+                    m = await self._peer_meta(a, oid)
+                    if m is not None:
+                        sources = [(a, m)]
+                        break
+            if not sources:
+                # all candidates unreachable (dying peers / fault window):
+                # back off before refreshing locations
+                await asyncio.sleep(0.1 * (attempt + 1))
+                continue
+            size = int(sources[0][1]["size"])
+            if await self._pull_striped(
+                oid, size, [a for a, _ in sources[:stripe]]
+            ):
+                return True
+            await asyncio.sleep(0.2 * (attempt + 1))
         return False
 
-    async def _fetch_from_node(self, oid, raylet_addr: str) -> bool:
-        """Chunked pull from a peer raylet into the local store."""
+    async def _pull_same_host_shm(self, oid, node: Dict) -> bool:
+        """Same-host fast path: attach the peer raylet's store arena by
+        file path and copy the sealed object arena-to-arena — no sockets
+        (parity: the reference shares plasma objects between same-node
+        consumers without a transfer). Guarded by peer LIVENESS (a
+        pooled-conn dial): a dead node's leftover arena must not
+        resurrect objects the cluster considers lost."""
+        path = node.get("store_path")
+        if not path or not os.path.exists(path):
+            return False
+        addr = node["raylet_addr"]
         try:
-            reader, writer = await rpc.open_connection(raylet_addr)
-            peer = rpc.Connection(reader, writer, rpc._null_handler,
-                                  name="raylet-pull")
-            peer.start()
+            conn = await self._peer_pool.acquire(addr)
+        except Exception:
+            return False  # peer raylet not reachable: not provably live
+        self._peer_pool.release(addr, conn)
+        st = self._peer_stores.get(path)
+        if st is None or st.closed:
             try:
-                meta = await peer.call_async("read_object_meta", oid.binary(),
-                                             timeout=30)
-                if meta is None:
-                    return False
-                size = meta["size"]
-                chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
-                buf = await self._create_local_with_spill(oid, size)
-                if buf is None:
-                    return self.store.contains(oid)
-                try:
-                    for off in range(0, size, chunk):
-                        n = min(chunk, size - off)
-                        data = await peer.call_async(
-                            "read_object_chunk", [oid.binary(), off, n],
-                            timeout=60,
-                        )
-                        buf[off : off + n] = data
-                finally:
-                    del buf
-                self.store.seal(oid)
-                self.store.release(oid)
+                st = SharedMemoryStore.attach(path)
+            except Exception:
+                return False
+            self._peer_stores[path] = st
+        view = None
+        try:
+            view = st.get(oid, timeout=0)  # pins cross-process
+            if view is None:
+                return False  # not in memory there (e.g. spilled)
+            size = view.nbytes
+            buf = await self._create_local_with_spill(oid, size)
+            if buf is None:
+                return self.store.contains(oid)
+            t0 = time.perf_counter()
+            chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
+            try:
+                for off in range(0, size, chunk):
+                    n = min(chunk, size - off)
+                    buf[off : off + n] = view[off : off + n]
+                    self._transfer_bytes_in += n
+                    # big copies must not starve heartbeats/pulls
+                    await asyncio.sleep(0)
+            finally:
+                del buf
+            self.store.seal(oid)
+            self.store.release(oid)
+            dt = time.perf_counter() - t0
+            if size > 0 and dt > 0:
+                self._last_pull_gbps = round(size / dt / 1e9, 3)
+            try:
                 await self.gcs.call_async(
                     "add_object_location", [oid.binary(), self.node_id]
                 )
-                return True
-            finally:
-                peer._do_close()
+            except Exception:
+                logger.warning("location registration for %s failed",
+                               oid.hex()[:12])
+            return True
         except Exception as e:
-            logger.warning("pull of %s from %s failed: %s",
-                           oid.hex()[:12], raylet_addr, e)
+            logger.warning("same-host shm pull of %s failed: %r",
+                           oid.hex()[:12], e)
             try:
                 self.store.abort(oid)
             except Exception:
                 pass
             return False
+        finally:
+            if view is not None:
+                view.release()
+                try:
+                    st.release(oid)
+                except Exception:
+                    pass
+
+    async def _peer_meta(self, addr: str, oid):
+        """Object meta from one peer over its pooled connection; None =
+        peer unreachable or it no longer holds a copy."""
+        try:
+            conn = await self._peer_pool.acquire(addr)
+        except Exception:
+            return None
+        try:
+            meta = await conn.call_async(
+                "read_object_meta", oid.binary(),
+                timeout=float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s),
+            )
+        except Exception:
+            self._peer_pool.release(addr, conn, discard=True)
+            return None
+        self._peer_pool.release(addr, conn)
+        return meta
+
+    async def _pull_striped(self, oid, size: int, peers: List[str]) -> bool:
+        """Windowed, striped fetch into a freshly created store buffer.
+
+        Each peer runs ``object_transfer_window`` chunk requests in
+        flight (bandwidth is window*chunk per RTT, not chunk per RTT);
+        peers pop disjoint ranges off one shared queue, so large objects
+        stripe across every source. Chunk payloads arrive as RAW frames
+        and are copied once, transport thread -> store buffer
+        (receive-into-place). A failed peer hands its ranges back to the
+        queue for the survivors; if ranges remain unserved the partial
+        buffer is aborted exactly once and the caller may retry."""
+        import collections as _collections
+
+        from ray_tpu._private import conduit as _conduit
+
+        t_create = time.perf_counter()
+        buf = await self._create_local_with_spill(oid, size)
+        if buf is None:
+            return self.store.contains(oid)
+        t_create = time.perf_counter() - t_create
+        sink_target = _PullSink(buf)
+        # Deposit sink: when the native engine carries this process's
+        # peer connections, chunk payloads stream STRAIGHT off the
+        # socket into `buf` (frames are tagged with this token) — the
+        # kernel's recv copy is the only receive-side copy. On the
+        # asyncio fallback the frames arrive inline and sink_target
+        # copies them into place instead.
+        token = int.from_bytes(os.urandom(7), "big") + 1
+        native_sink = bool(GLOBAL_CONFIG.native_wire and
+                           _conduit.available())
+        if native_sink:
+            _conduit.Engine.get().sink_register(token, buf)
+        self._transfers[token] = sink_target
+        del buf
+        chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
+        ranges = _collections.deque(
+            (off, min(chunk, size - off)) for off in range(0, size, chunk)
+        )
+        total_ranges = len(ranges)
+        done = [0]
+        landed = sink_target.landed
+        window = max(1, int(GLOBAL_CONFIG.object_transfer_window))
+        timeout_s = float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s)
+        chunk_tries = 1 + max(
+            0, int(GLOBAL_CONFIG.object_transfer_chunk_retries)
+        )
+        t0 = time.perf_counter()
+
+        async def fetch_batch(conn, todo):
+            """One streamed batch request: the peer pushes each chunk as
+            a raw frame (deposited natively or copied inline by
+            _on_obj_chunk), then replies — ordered delivery means every
+            frame of the batch precedes the reply, so arrival is checked
+            against the ledger right after."""
+            reply = await conn.call_async(
+                "read_object_chunks",
+                [oid.binary(), [[o, n] for o, n in todo], token],
+                timeout=timeout_s,
+            )
+            if reply is None:
+                raise ValueError("peer lost its copy mid-pull")
+
+        async def fetch_legacy(conn, todo):
+            """Per-chunk fallback for peers without the batch endpoint."""
+            for off, n in todo:
+                def sink(meta, mv, _off=off, _n=n):
+                    if len(mv) != _n:
+                        raise ValueError("chunk size mismatch")
+                    if sink_target.write(_off, mv):
+                        sink_target.record(_off, _n)
+
+                meta = await conn.call_raw_async(
+                    "read_object_chunk_raw",
+                    [oid.binary(), off, n, token], sink,
+                    timeout=timeout_s,
+                )
+                if meta is None:
+                    raise ValueError("peer lost its copy mid-pull")
+                if native_sink:
+                    sink_target.record(off, n)
+
+        async def run_peer(addr: str) -> bool:
+            """Drain ranges through one peer; True = no transport fault."""
+            try:
+                conn = await self._peer_pool.acquire(addr)
+            except Exception:
+                return False
+            conn.raw_notify["obj_chunk"] = self._on_obj_chunk
+            state = {"failed": False}
+            batch_sem = asyncio.Semaphore(2)  # double-buffered batches
+            tasks = []
+
+            async def run_batch(batch):
+                self._pull_chunks_inflight += len(batch)
+                err = None
+                try:
+                    for i in range(chunk_tries):
+                        todo = [r for r in batch if landed.get(r[0]) != r[1]]
+                        if not todo:
+                            break
+                        if i:
+                            # a chaos-dropped frame costs one timeout,
+                            # not the whole striped attempt
+                            self._transfer_chunk_retries += 1
+                        try:
+                            if state.get("legacy"):
+                                await fetch_legacy(conn, todo)
+                            else:
+                                await fetch_batch(conn, todo)
+                        except rpc.RpcError as e:
+                            if "unknown method" in str(e) and not (
+                                state.get("legacy")
+                            ):
+                                state["legacy"] = True  # pre-batch peer
+                                continue
+                            err = e
+                            break
+                        except Exception as e:
+                            err = e
+                            if conn.closed:
+                                break
+                    missing = [
+                        r for r in batch if landed.get(r[0]) != r[1]
+                    ]
+                    if missing:
+                        state["failed"] = True
+                        if not state.get("logged"):
+                            state["logged"] = True
+                            logger.warning(
+                                "batch fetch of %s from %s failed "
+                                "(%d/%d chunks missing): %r",
+                                oid.hex()[:12], addr, len(missing),
+                                len(batch), err,
+                            )
+                        ranges.extend(missing)  # survivors take over
+                    # landed chunks count exactly once, at their batch
+                    for off, n in batch:
+                        if landed.get(off) == n:
+                            done[0] += 1
+                            self._transfer_bytes_in += n
+                finally:
+                    self._pull_chunks_inflight -= len(batch)
+                    batch_sem.release()
+
+            try:
+                while ranges and not state["failed"]:
+                    batch = []
+                    while ranges and len(batch) < window:
+                        batch.append(ranges.popleft())
+                    if not batch:
+                        break
+                    await batch_sem.acquire()
+                    if state["failed"]:
+                        ranges.extend(batch)
+                        batch_sem.release()
+                        break
+                    tasks.append(
+                        asyncio.get_running_loop().create_task(
+                            run_batch(batch)
+                        )
+                    )
+                if tasks:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+            finally:
+                self._peer_pool.release(
+                    addr, conn, discard=state["failed"]
+                )
+            return not state["failed"]
+
+        survivors = list(peers)
+        while ranges and survivors:
+            done_before = done[0]
+            results = await asyncio.gather(*(run_peer(a) for a in survivors))
+            survivors = [a for a, ok in zip(survivors, results) if ok]
+            if done[0] == done_before:
+                break  # zero chunks landed this round: don't spin
+
+        self._transfers.pop(token, None)
+        if native_sink:
+            # blocks until any in-flight native deposit completes: after
+            # this, seal/abort cannot race an engine write, and straggler
+            # frames for the token are discarded by the engine
+            _conduit.Engine.get().sink_unregister(token)
+        # completeness comes from the arrival ledger, not the done[]
+        # counter: a chunk landing between a timed-out batch's `missing`
+        # computation and its count loop gets requeued AND counted, then
+        # counted again by the survivor that re-serves it — the ledger
+        # is immune to that double-count (and to duplicates generally)
+        complete = all(
+            landed.get(off) == min(chunk, size - off)
+            for off in range(0, size, chunk)
+        )
+        if complete:
+            t_seal = time.perf_counter()
+            sink_target.close()
+            self.store.seal(oid)
+            self.store.release(oid)
+            dt = time.perf_counter() - t0
+            if size > 0 and dt > 0:
+                self._last_pull_gbps = round(size / dt / 1e9, 3)
+            if os.environ.get("RAYTPU_TRANSFER_TRACE"):
+                logger.info(
+                    "pull %s: create=%.3fs transfer=%.3fs seal=%.3fs "
+                    "(%.3f GB/s wire)",
+                    oid.hex()[:12], t_create, t_seal - t0,
+                    time.perf_counter() - t_seal,
+                    size / max(t_seal - t0, 1e-9) / 1e9,
+                )
+            try:
+                await self.gcs.call_async(
+                    "add_object_location", [oid.binary(), self.node_id]
+                )
+            except Exception:
+                logger.warning("location registration for %s failed",
+                               oid.hex()[:12])
+            return True
+        # failure: stop straggler writes, then abort the partial buffer
+        # exactly once (this is the only abort site for this attempt)
+        self._pull_aborts += 1
+        sink_target.close()
+        try:
+            self.store.abort(oid)
+        except Exception:
+            pass
+        logger.warning(
+            "striped pull of %s failed (%d/%d chunks, peers=%s)",
+            oid.hex()[:12], done[0], total_ranges, len(peers),
+        )
+        return False
+
+    def _on_obj_chunk(self, conn, meta, payload, token, deposited):
+        """Inbound chunk frame of a streamed batch (transport thread:
+        conduit reaper or IO loop). Native deposits already landed in
+        the store buffer — just record; inline payloads copy into place
+        here. Unknown tokens (aborted/finished transfers) are dropped."""
+        sink_target = self._transfers.get(int(token))
+        if sink_target is None:
+            return
+        off, n = int(meta[0]), int(meta[1])
+        if deposited is None:
+            if len(payload) == n and sink_target.write(off, payload):
+                sink_target.record(off, n)
+        elif deposited == n:
+            sink_target.record(off, n)
+        # deposited mismatch / -1 (discarded): not recorded — the batch
+        # check re-fetches the range
+
+    async def rpc_read_object_chunks(self, conn, data):
+        """Streamed batch serve: push every requested chunk as a RAW
+        frame (zero-copy out of the shm mmap, deposit-tagged for
+        receive-into-place), then reply. Ordered delivery makes the
+        reply a barrier: when the puller sees it, every chunk frame of
+        the batch has been delivered (or the conn died). The store pin
+        is held until the LAST chunk's bytes leave the process; outbound
+        pacing bounds pinned in-flight bytes."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_bytes, req_ranges, token = data[0], data[1], data[2]
+        oid = ObjectID(oid_bytes)
+        view = self.store.get(oid, timeout=0)
+        if view is None and await self._restore_object(oid):
+            view = self.store.get(oid, timeout=0)
+        if view is None:
+            return None
+        lock = threading.Lock()
+        remaining = [1]  # the handler itself holds one ref
+
+        def unref():
+            with lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                try:
+                    view.release()
+                    self.store.release(oid)
+                except Exception:
+                    pass
+
+        served = 0
+        try:
+            for off, n in req_ranges:
+                off, n = int(off), int(n)
+                if off < 0 or n < 0 or off + n > view.nbytes:
+                    break  # malformed range: stop serving the batch
+                await self._outbound_sem.acquire()
+                self._outbound_chunks += 1
+                self._transfer_bytes_out += n
+                sub = view[off : off + n]
+                with lock:
+                    remaining[0] += 1
+
+                def on_sent(_sub=sub):
+                    # reaper thread (conduit) / IO loop (asyncio): the
+                    # bytes left the process — drop this chunk's refs
+                    # and hand the pacing slot back
+                    try:
+                        _sub.release()
+                    except Exception:
+                        pass
+                    unref()
+                    try:
+                        self._loop.call_soon_threadsafe(
+                            self._outbound_sem.release
+                        )
+                    except RuntimeError:
+                        pass  # loop closed (raylet shutdown)
+
+                try:
+                    conn.send_raw_frame(
+                        rpc._NOTIFY, None, "obj_chunk", [off, n], sub,
+                        on_sent=on_sent, token=int(token), off=off,
+                    )
+                except Exception:
+                    break  # conn died; on_sent already fired
+                served += 1
+        finally:
+            unref()
+        return {"served": served}
 
     async def rpc_read_object_meta(self, conn, oid_bytes: bytes):
+        """Size + spill state of a local copy. Does NOT force a restore:
+        pullers use the ``spilled`` flag to prefer in-memory peers, and a
+        spilled copy restores lazily when its chunks are requested."""
         from ray_tpu._private.ids import ObjectID
 
         view = self.store.get(ObjectID(oid_bytes), timeout=0)
-        if view is None and await self._restore_object(ObjectID(oid_bytes)):
-            view = self.store.get(ObjectID(oid_bytes), timeout=0)
+        if view is not None:
+            size = view.nbytes
+            view.release()
+            self.store.release(ObjectID(oid_bytes))
+            self._objects_served += 1
+            return {"size": size, "spilled": False}
+        entry = self.spilled.get(oid_bytes)
+        if entry is not None:
+            self._objects_served += 1
+            return {"size": entry[1], "spilled": True}
+        return None
+
+    async def rpc_read_object_chunk_raw(self, conn, data):
+        """Serve one chunk as a RAW frame: the payload is a memoryview
+        straight over the shm store mmap, written out by the transport's
+        scatter-gather send — no Python-level copy, no msgpack encode of
+        the bulk bytes. The store pin is held until the transport reports
+        the bytes left the process (on_sent), bounded in aggregate by the
+        outbound semaphore (push-manager pacing role)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_bytes, off, n = data[0], data[1], data[2]
+        token = int(data[3]) if len(data) > 3 else 0
+        oid = ObjectID(oid_bytes)
+        # a spilled object restores BEFORE pacing: a multi-second disk
+        # restore must not occupy an outbound slot
+        view = self.store.get(oid, timeout=0)
+        if view is None and await self._restore_object(oid):
+            view = self.store.get(oid, timeout=0)
         if view is None:
             return None
-        size = view.nbytes
-        view.release()
-        self.store.release(ObjectID(oid_bytes))
-        self._objects_served += 1
-        return {"size": size}
+        await self._outbound_sem.acquire()
+        self._outbound_chunks += 1
+        self._transfer_bytes_out += int(n)
+        sub = view[off : off + n]
+
+        def on_sent():
+            # conduit reaper thread (or IO loop on the asyncio fallback):
+            # drop the store pin, then hand the pacing slot back on the
+            # raylet loop
+            try:
+                sub.release()
+                view.release()
+                self.store.release(oid)
+            except Exception:
+                pass
+            try:
+                self._loop.call_soon_threadsafe(self._outbound_sem.release)
+            except RuntimeError:
+                pass  # loop already closed (raylet shutdown)
+
+        return rpc.RawReply([int(off), int(n)], sub, on_sent=on_sent,
+                            token=token, off=int(off))
 
     async def rpc_read_object_chunk(self, conn, data):
+        """Legacy msgpack chunk read (kept for mixed-version interop and
+        direct debugging; the pull path uses read_object_chunk_raw)."""
         from ray_tpu._private.ids import ObjectID
 
         oid_bytes, off, n = data
         oid = ObjectID(oid_bytes)
-        # a spilled object restores BEFORE pacing: a multi-second disk
-        # restore must not occupy an outbound slot and stall every other
-        # node's in-memory pulls
         view = self.store.get(oid, timeout=0)
         if view is None and await self._restore_object(oid):
             view = self.store.get(oid, timeout=0)
         if view is None:
             return None
         try:
-            # chunk-granular pacing: bound concurrent outbound reads so N
-            # simultaneous pullers interleave fairly instead of thrashing
-            # the source (parity: reference PushManager chunk pacing,
-            # push_manager.h:30)
             async with self._outbound_sem:
                 self._outbound_chunks += 1
+                self._transfer_bytes_out += int(n)
                 return bytes(view[off : off + n])
         finally:
             view.release()
@@ -1583,6 +2207,16 @@ class Raylet:
             "objects_served": self._objects_served,
             "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
+            "transfer": {
+                "bytes_in": self._transfer_bytes_in,
+                "bytes_out": self._transfer_bytes_out,
+                "last_pull_gbps": self._last_pull_gbps,
+                "chunks_inflight": self._pull_chunks_inflight,
+                "pulls_inflight": len(self._pulls_inflight),
+                "pull_aborts": self._pull_aborts,
+                "chunk_retries": self._transfer_chunk_retries,
+                "peer_conns": self._peer_pool.stats(),
+            },
         }
 
     # ------------- per-node agent surface (round 5) -------------

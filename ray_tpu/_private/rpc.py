@@ -40,8 +40,50 @@ from ray_tpu._private import chaos as _chaos
 
 _REQUEST, _REPLY, _ERROR, _NOTIFY = 0, 1, 2, 3
 
-_MAX_FRAME = 1 << 31
+# Length-word MSB marks a RAW frame (see conduit.cpp): the body is
+# [u32 BE hlen][u64 BE deposit-token][u64 BE deposit-off]
+# [msgpack header [kind, seqno, method, meta]][payload], where the
+# payload bytes are NOT msgpack — bulk data (object-store chunks)
+# crosses without a msgpack encode/decode of the bytes. A conduit
+# receiver with a registered deposit sink for the token streams the
+# payload STRAIGHT off the socket into the destination buffer
+# (receive-into-place); every other receiver copies it out of the frame
+# body. Both transports speak the format, so conduit and asyncio peers
+# interoperate.
+_RAW_FLAG = 0x80000000
+_RAW_FIXED = 20  # hlen word + deposit token + deposit offset
+_MAX_FRAME = 1 << 30
 _DRAIN_HIGH_WATER = 4 << 20  # bytes buffered before writers must drain
+
+
+class RawReply:
+    """Returned by a server handler to answer with a RAW frame: ``meta``
+    (small, msgpack'd into the header) plus ``payload`` — bulk bytes the
+    transport sends without a Python-level copy (conduit: writev straight
+    from the buffer; asyncio: handed to the transport as a memoryview).
+    ``on_sent`` fires exactly once when the transport no longer
+    references ``payload`` (sent, conn died, or send failed) — release
+    pins (e.g. object-store refcounts) there. ``token``/``off`` address a
+    deposit sink on the receiver (0 = none: the receiver handles the
+    payload inline). Handlers returning RawReply must be invoked without
+    a request id: raw replies are not replayable from the dedup cache."""
+
+    __slots__ = ("meta", "payload", "token", "off", "_on_sent")
+
+    def __init__(self, meta, payload, on_sent=None, token=0, off=0):
+        self.meta = meta
+        self.payload = payload
+        self.token = int(token)
+        self.off = int(off)
+        self._on_sent = on_sent
+
+    def fire_sent(self):
+        cb, self._on_sent = self._on_sent, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logging.getLogger(__name__).exception("on_sent failed")
 
 
 def parse_addr(addr: str):
@@ -141,6 +183,12 @@ class Connection:
         # method -> fn(conn, data): notifies dispatched INLINE in the read
         # loop (no handler task) — the data-plane reply hot path
         self.sync_notify: Dict[str, Callable] = {}
+        # raw-frame plumbing: seqno -> sink for in-flight call_raw_async
+        # (sink(meta, payload_view) runs inline in the read loop, copying
+        # the payload into its destination before the buffer is dropped);
+        # method -> fn(conn, meta, payload_view) for inbound raw notifies
+        self._raw_sinks: Dict[int, Callable] = {}
+        self.raw_notify: Dict[str, Callable] = {}
         self._cork = bytearray()  # send_notify_corked accumulator
         # chaos-plane link identity: servers may tag the peer (e.g. the GCS
         # tags a registering raylet's conn) so node-pair partitions match
@@ -154,10 +202,14 @@ class Connection:
         try:
             while True:
                 hdr = await self.reader.readexactly(4)
-                n = int.from_bytes(hdr, "big")
+                word = int.from_bytes(hdr, "big")
+                n = word & ~_RAW_FLAG
                 if n > _MAX_FRAME:
                     raise ConnectionError("frame too large")
                 body = await self.reader.readexactly(n)
+                if word & _RAW_FLAG:
+                    self._on_raw_body(memoryview(body))
+                    continue
                 msg = msgpack.unpackb(body, raw=False)
                 kind, seqno, method, data = msg[0], msg[1], msg[2], msg[3]
                 rid = msg[4] if len(msg) > 4 else None
@@ -190,6 +242,45 @@ class Connection:
         finally:
             self._do_close()
 
+    def _on_raw_body(self, body: memoryview):
+        """Dispatch one raw frame (read loop, inline): the payload view is
+        only valid for the duration of the sink call — sinks copy into
+        their destination buffer. (The asyncio transport has no native
+        deposit path; deposit-token frames are handled inline here.)"""
+        if len(body) < _RAW_FIXED:
+            raise ConnectionError("raw frame too short")
+        hlen = int.from_bytes(body[:4], "big")
+        token = int.from_bytes(body[4:12], "big")
+        if _RAW_FIXED + hlen > len(body):
+            raise ConnectionError("raw frame header overruns body")
+        header = msgpack.unpackb(
+            bytes(body[_RAW_FIXED : _RAW_FIXED + hlen]), raw=False
+        )
+        kind, seqno, method, meta = header[0], header[1], header[2], header[3]
+        payload = body[_RAW_FIXED + hlen :]
+        if kind == _REPLY:
+            sink = self._raw_sinks.pop(seqno, None)
+            fut = self._pending.pop(seqno, None)
+            try:
+                if sink is not None:
+                    sink(meta, payload)
+                if fut is not None and not fut.done():
+                    fut.set_result(meta)
+            except Exception as e:
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+        elif kind == _NOTIFY:
+            fn = self.raw_notify.get(method)
+            if fn is not None:
+                try:
+                    # deposited=None: the asyncio transport always
+                    # delivers the payload inline
+                    fn(self, meta, payload, token, None)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "raw notify handler %s failed", method
+                    )
+
     async def _handle(self, seqno, method, data, rid=None):
         t0 = time.monotonic()
         kind, payload = await run_idempotent(
@@ -198,6 +289,27 @@ class Connection:
         if kind == _REPLY:
             _global_stats.record(method, (time.monotonic() - t0) * 1e3)
         if seqno is not None:
+            if kind == _REPLY and isinstance(payload, RawReply):
+                try:
+                    # asyncio transport consumes the buffer synchronously
+                    # (copied into the kernel or its own buffer by
+                    # write()), so on_sent fires before return
+                    self.send_raw_frame(
+                        _REPLY, seqno, method, payload.meta,
+                        payload.payload, on_sent=payload.fire_sent,
+                        token=payload.token, off=payload.off,
+                    )
+                    # raw payloads are bulk: without this drain the
+                    # pacing semaphore (released by on_sent at write())
+                    # bounds nothing on the asyncio transport and a slow
+                    # puller's chunks pile up in the writer buffer
+                    if (self.writer.transport.get_write_buffer_size()
+                            > _DRAIN_HIGH_WATER):
+                        async with self._write_lock:
+                            await self.writer.drain()
+                except Exception:
+                    pass
+                return
             try:
                 await self._send(kind, seqno, method, payload)
             except Exception:
@@ -248,6 +360,63 @@ class Connection:
         if self.writer.transport.get_write_buffer_size() > _DRAIN_HIGH_WATER:
             async with self._write_lock:
                 await self.writer.drain()
+
+    def send_raw_frame(self, kind, seqno, method, meta, payload,
+                       on_sent=None, token=0, off=0):
+        """Write one RAW frame (IO-loop thread only). The payload buffer
+        is handed to the transport as-is — no Python-level copy (the
+        transport copies into the kernel or its own buffer before this
+        returns, so ``on_sent`` fires — exactly once — before return,
+        success or failure)."""
+        try:
+            hdr = msgpack.packb([kind, seqno, method, meta],
+                                use_bin_type=True)
+            total = _RAW_FIXED + len(hdr) + len(payload)
+            if total > _MAX_FRAME:
+                raise SendError("raw frame exceeds 1 GiB cap")
+            if self._closed or self.writer.is_closing():
+                raise SendError(f"connection {self.name} closed")
+            prefix = (
+                (_RAW_FLAG | total).to_bytes(4, "big")
+                + len(hdr).to_bytes(4, "big")
+                + int(token).to_bytes(8, "big")
+                + int(off).to_bytes(8, "big")
+                + hdr
+            )
+            if _chaos._PLANE is not None:
+                # chaos path (tests): one materialized frame through the
+                # gate
+                frame = prefix + bytes(payload)
+                if not self._chaos_gate(frame):
+                    self.writer.write(frame)
+                return
+            self.writer.write(prefix)
+            self.writer.write(payload)
+        finally:
+            if on_sent is not None:
+                on_sent()
+
+    async def call_raw_async(self, method: str, data: Any, sink,
+                             timeout=None) -> Any:
+        """Request whose reply arrives as a RAW frame: ``sink(meta,
+        payload_view)`` runs inline in the read loop — copy the payload
+        into its destination there — and the call returns ``meta``. A
+        normal (msgpack) error reply still raises RpcError."""
+        seqno = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seqno] = fut
+        self._raw_sinks[seqno] = sink
+        try:
+            try:
+                await self._send(_REQUEST, seqno, method, data)
+            except Exception as e:
+                raise SendError(str(e)) from e
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(seqno, None)
+            self._raw_sinks.pop(seqno, None)
 
     async def call_async(self, method: str, data: Any, timeout=None,
                          rid=None) -> Any:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import sys
 import threading
 from typing import Any, List, Tuple
 
@@ -97,6 +98,9 @@ class _PinnedSlice:
     _PinnedSlice ties the store's refcount (held by ``pin``) to the lifetime
     of ALL views — the object cannot be LRU-evicted from under live arrays
     (parity: reference PlasmaClient buffer pinning, plasma/client.h).
+
+    Requires Python >= 3.12: ``__buffer__`` (PEP 688) is ignored by older
+    interpreters — see ``_pinned_buffer`` for the pre-3.12 equivalent.
     """
 
     __slots__ = ("_view", "_pin")
@@ -106,10 +110,46 @@ class _PinnedSlice:
         self._pin = pin
 
     def __buffer__(self, flags):
-        return memoryview(self._view)
+        # the pin path feeds a WRITABLE store view (see _pinned_buffer);
+        # consumers must still see the sealed object as immutable
+        return memoryview(self._view).toreadonly()
 
     def __release_buffer__(self, view):
         view.release()
+
+
+if sys.version_info >= (3, 12):
+    def _pinned_buffer(view: memoryview, pin):
+        return _PinnedSlice(view, pin)
+else:
+    import ctypes as _ctypes
+
+    # Pre-3.12 pinned buffer: Python classes cannot implement the buffer
+    # protocol before PEP 688, and an ndarray subclass does not work either
+    # (numpy collapses base chains through non-owning arrays, dropping the
+    # subclass — and the pin with it).  A ctypes array is a C-level buffer
+    # exporter numpy can NOT collapse through; the buffer handed to pickle is
+    # ``memoryview(carrier).toreadonly()``, so consumers see an immutable
+    # view whose ``.obj`` is the carrier — ``np.frombuffer`` keeps the
+    # memoryview as ``.base``, the memoryview keeps the carrier, and the
+    # carrier keeps the pin.  ``from_buffer`` needs a writable source, which
+    # is why the store's pin path requests ``get(..., writable=True)``.
+    _ctype_cache = {}
+
+    def _pinned_buffer(view: memoryview, pin):
+        if view.readonly:
+            # No writable source to hang a ctypes carrier on: copy rather
+            # than hand out an unpinned zero-copy view (use-after-evict).
+            return bytes(view)
+        n = view.nbytes
+        cls = _ctype_cache.get(n)
+        if cls is None:
+            cls = type("_PinnedBuf", (_ctypes.c_ubyte * n,), {})
+            if len(_ctype_cache) < 4096:  # bound type-object growth
+                _ctype_cache[n] = cls
+        carrier = cls.from_buffer(view)
+        carrier._pin = pin
+        return memoryview(carrier).toreadonly()
 
 
 def unpack(data, pin=None) -> Any:
@@ -130,6 +170,6 @@ def unpack(data, pin=None) -> Any:
     buffers = []
     for n in lens:
         b = mv[pos : pos + n]
-        buffers.append(b if pin is None else _PinnedSlice(b, pin))
+        buffers.append(b if pin is None else _pinned_buffer(b, pin))
         pos += n
     return deserialize(meta, buffers)

@@ -76,8 +76,15 @@ def init(
 
     cluster = Cluster(gcs_address=address)
     if address is None:
-        cluster.start_gcs(system_config)
+        # no wait: the raylet (and the driver below) connect-retry while
+        # the GCS binds, so both daemons boot concurrently
+        cluster.start_gcs(system_config, wait=False)
     cluster.add_node(resources=res, head=True)
+    if cluster.gcs_proc is not None and cluster.gcs_proc.poll() is not None:
+        raise RuntimeError(
+            f"GCS exited with {cluster.gcs_proc.returncode} during startup "
+            f"(see {cluster.session_dir}/logs/gcs.log)"
+        )
     global_worker.cluster = cluster
     connect(
         raylet_addr=cluster.head_node.raylet_addr,

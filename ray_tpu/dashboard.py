@@ -157,9 +157,10 @@ def _prometheus_text() -> str:
                 for i, count in enumerate(val["counts"]):
                     cum += count
                     le = esc(bounds[i]) if i < len(bounds) else "+Inf"
+                    # pre-3.12 f-strings cannot contain a backslash
+                    le_tag = 'le="%s"' % le
                     lines.append(
-                        f"{name}_bucket{fmt_tags(tkey, [f'le=\"{le}\"'])} "
-                        f"{cum}"
+                        f"{name}_bucket{fmt_tags(tkey, [le_tag])} {cum}"
                     )
                 lines.append(f"{name}_sum{fmt_tags(tkey)} {val['sum']}")
                 lines.append(f"{name}_count{fmt_tags(tkey)} {cum}")

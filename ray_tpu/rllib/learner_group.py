@@ -61,11 +61,20 @@ class LearnerGroup:
             updates, opt_state = self.opt.update(grads, opt_state)
             return optax.apply_updates(params, updates), opt_state, loss
 
+        # Donation reuses param/opt-state memory in place — the point on
+        # TPU, where those buffers dominate HBM.  On the CPU backend it is
+        # DISABLED: jaxlib's CPU client aliases host numpy memory both ways
+        # (device_put and device_get are zero-copy views), and donating
+        # such buffers in a multi-threaded driver corrupts the glibc heap
+        # (reproducible SIGSEGV/"corrupted double-linked list" in
+        # test_impala_learns_cartpole_async; host-copy round trips do not
+        # help).  CPU runs are tests/sims where the memory win is nil.
+        donate = () if jax.default_backend() == "cpu" else (0, 1)
         self._update = jax.jit(
             update,
             in_shardings=(self._repl, self._repl, self._batch_sh),
             out_shardings=(self._repl, self._repl, self._repl),
-            donate_argnums=(0, 1),
+            donate_argnums=donate,
         )
 
     def update(self, batch: Dict[str, np.ndarray],

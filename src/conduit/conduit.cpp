@@ -51,27 +51,59 @@
 
 namespace {
 
-constexpr uint32_t kMaxFrame = 1u << 31;
-constexpr size_t kReadChunk = 256 * 1024;
+// Frame cap is 1 GiB: the length word's top bit is the RAW-frame marker
+// (see below), leaving 31 bits; anything beyond 1 GiB is a protocol
+// error either way (bulk data crosses in chunks, never one frame).
+constexpr uint32_t kMaxFrame = 1u << 30;
+// Length-word MSB: marks a RAW frame. Body layout of a raw frame:
+//   [u32 BE hlen][u64 BE deposit-token][u64 BE deposit-off]
+//   [hlen bytes msgpack header][payload bytes]
+// The header is a normal [kind, seqno, method, meta] message; the
+// payload bytes after it are NOT msgpack. With token == 0 the whole
+// body is delivered as one EV_RAW event (receiver copies the payload
+// out of the event body). With token != 0 and a matching registered
+// sink (cd_sink_register), the engine streams the payload STRAIGHT OFF
+// THE SOCKET into sink.base + off — recv()'s kernel copy is the only
+// receive-side copy; the EV_RAW event then carries just the header
+// region, with aux = deposited byte count (-1 if the sink was missing,
+// dead, or out of bounds and the payload was discarded).
+constexpr uint32_t kRawFlag = 0x80000000u;
+constexpr size_t kRawFixed = 20;  // hlen word + token + off
 
 enum EventKind : int32_t {
   EV_FRAME = 0,
   EV_ACCEPTED = 1,
   EV_CLOSED = 2,
   EV_LISTEN_ERROR = 3,
+  EV_SENT = 4,   // an external (zero-copy) buffer fully flushed/abandoned
+  EV_RAW = 5,    // raw frame body ([u32 hlen][header][payload])
 };
+
+constexpr size_t kReadChunk = 1024 * 1024;
+// Socket buffer request: bulk object-plane frames (8 MiB chunks) run at
+// a fraction of memcpy speed with the ~208 KiB default buffers (every
+// writev/recv round trips the epoll loop); the kernel clamps to
+// wmem_max/rmem_max if lower.
+constexpr int kSockBuf = 4 * 1024 * 1024;
 
 struct CdEvent {
   int64_t conn;
   int32_t kind;
   uint32_t len;
-  uint8_t* data;   // malloc'd frame body (EV_FRAME); caller frees via cd_free
-  int64_t aux;     // listener id for EV_ACCEPTED
+  uint8_t* data;   // malloc'd frame body (EV_FRAME/EV_RAW); cd_free it
+  int64_t aux;     // listener id (EV_ACCEPTED); send token (EV_SENT)
 };
 
 struct OutBuf {
-  std::vector<uint8_t> data;
+  std::vector<uint8_t> data;  // owned bytes (length prefix + header/body)
   size_t off = 0;
+  // Zero-copy tail (cd_send_iov): written via writev straight from the
+  // caller's memory (e.g. the shm object-store mmap). The caller keeps
+  // that memory valid until EV_SENT delivers `token`.
+  const uint8_t* ext = nullptr;
+  size_t ext_len = 0;
+  size_t ext_off = 0;
+  int64_t token = 0;  // 0 = no completion event wanted
 };
 
 struct Conn {
@@ -84,6 +116,27 @@ struct Conn {
   // read reassembly (engine thread only)
   std::vector<uint8_t> rbuf;
   size_t rpos = 0;  // parse cursor into rbuf
+  // active raw-deposit stream (engine thread only): payload bytes of
+  // the current raw frame go straight from the socket into the
+  // registered sink instead of through rbuf
+  bool streaming = false;
+  bool stream_discard = false;
+  int64_t stream_token = 0;
+  uint64_t stream_off = 0;
+  uint64_t stream_written = 0;
+  uint64_t stream_left = 0;
+  uint8_t* ev_hdr = nullptr;  // malloc'd header region for the event
+  uint32_t ev_hdr_len = 0;
+};
+
+// A registered deposit region (e.g. an object-store create buffer).
+// in_use counts engine-side writes in progress; unregister waits for
+// them so the owner can free/abort the memory race-free.
+struct Sink {
+  uint8_t* base = nullptr;
+  uint64_t len = 0;
+  int in_use = 0;
+  bool dead = false;
 };
 
 struct Listener {
@@ -97,9 +150,11 @@ struct Engine {
   std::thread thr;
   std::atomic<bool> stop{false};
 
-  std::mutex mu;  // guards conns map mutation, outq, pending ops
+  std::mutex mu;  // guards conns map mutation, outq, sinks, pending ops
   std::unordered_map<int64_t, Conn*> conns;
   std::unordered_map<int64_t, Listener*> listeners;
+  std::unordered_map<int64_t, Sink*> sinks;
+  std::condition_variable sink_cv;  // with mu: unregister vs in-flight write
   int64_t next_id = 1;
   std::vector<int64_t> pending_close;
 
@@ -150,15 +205,23 @@ void epoll_mod(Engine* e, Conn* c, bool want_out) {
   epoll_ctl(e->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
-// Engine thread: close + free a conn, emit EV_CLOSED.
+// Engine thread: close + free a conn, emit EV_SENT for abandoned
+// zero-copy buffers (their memory is no longer referenced; the owner
+// must be released) then EV_CLOSED.
 void destroy_conn(Engine* e, Conn* c) {
+  std::vector<int64_t> abandoned;
   {
     std::lock_guard<std::mutex> g(e->mu);
     e->conns.erase(c->id);
+    for (auto& b : c->outq)
+      if (b.token) abandoned.push_back(b.token);
   }
   epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
+  for (int64_t tok : abandoned)
+    push_event(e, CdEvent{c->id, EV_SENT, 0, nullptr, tok});
   push_event(e, CdEvent{c->id, EV_CLOSED, 0, nullptr, 0});
+  if (c->ev_hdr) free(c->ev_hdr);  // died mid-deposit-stream
   delete c;
 }
 
@@ -172,9 +235,13 @@ bool flush_conn(Engine* e, Conn* c) {
       std::lock_guard<std::mutex> g(e->mu);
       for (auto& b : c->outq) {
         if (n == 64) break;
-        iov[n].iov_base = b.data.data() + b.off;
-        iov[n].iov_len = b.data.size() - b.off;
-        n++;
+        size_t dav = b.data.size() - b.off;
+        if (dav > 0) { iov[n].iov_base = (void*)(b.data.data() + b.off);
+                       iov[n].iov_len = dav; n++; }
+        if (n == 64) break;
+        size_t eav = b.ext_len - b.ext_off;
+        if (eav > 0) { iov[n].iov_base = (void*)(b.ext + b.ext_off);
+                       iov[n].iov_len = eav; n++; }
       }
     }
     if (n == 0) {
@@ -190,37 +257,190 @@ bool flush_conn(Engine* e, Conn* c) {
       if (errno == EINTR) continue;
       return false;
     }
-    std::lock_guard<std::mutex> g(e->mu);
-    size_t left = (size_t)w;
-    c->out_bytes -= left;
-    while (left > 0 && !c->outq.empty()) {
-      OutBuf& b = c->outq.front();
-      size_t avail = b.data.size() - b.off;
-      if (left >= avail) {
-        left -= avail;
-        c->outq.pop_front();
-      } else {
-        b.off += left;
-        left = 0;
+    std::vector<int64_t> sent;
+    {
+      std::lock_guard<std::mutex> g(e->mu);
+      size_t left = (size_t)w;
+      c->out_bytes -= left;
+      while (!c->outq.empty()) {
+        OutBuf& b = c->outq.front();
+        size_t take = std::min(left, b.data.size() - b.off);
+        b.off += take;
+        left -= take;
+        size_t etake = std::min(left, b.ext_len - b.ext_off);
+        b.ext_off += etake;
+        left -= etake;
+        if (b.off == b.data.size() && b.ext_off == b.ext_len) {
+          if (b.token) sent.push_back(b.token);
+          c->outq.pop_front();
+        } else {
+          break;
+        }
       }
     }
+    for (int64_t tok : sent)
+      push_event(e, CdEvent{c->id, EV_SENT, 0, nullptr, tok});
   }
 }
 
-// Parse complete frames out of c->rbuf, emit EV_FRAME events.
+uint32_t be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+uint64_t be64(const uint8_t* p) {
+  return ((uint64_t)be32(p) << 32) | be32(p + 4);
+}
+
+// Engine thread: the active stream's raw frame is fully received —
+// emit the header-only EV_RAW (aux = deposited bytes, -1 = discarded)
+// and return the conn to normal framing.
+void finish_stream(Engine* e, Conn* c) {
+  push_event(e, CdEvent{c->id, EV_RAW, c->ev_hdr_len, c->ev_hdr,
+                        c->stream_discard ? -1 : (int64_t)c->stream_written});
+  c->ev_hdr = nullptr;
+  c->ev_hdr_len = 0;
+  c->streaming = false;
+  c->stream_discard = false;
+  c->stream_token = 0;
+  c->stream_off = c->stream_written = c->stream_left = 0;
+}
+
+// Engine thread: deposit `n` payload bytes already sitting in memory
+// (rbuf prefix of the frame) into the stream's sink.
+void deposit_copy(Engine* e, Conn* c, const uint8_t* src, size_t n) {
+  if (!c->stream_discard) {
+    std::unique_lock<std::mutex> g(e->mu);
+    auto it = e->sinks.find(c->stream_token);
+    Sink* s = (it == e->sinks.end()) ? nullptr : it->second;
+    // wrap-safe bound: stream_off is wire-controlled (be64), so the
+    // naive off+written+n sum could overflow past the check
+    if (!s || s->dead || c->stream_off > s->len ||
+        c->stream_written + n > s->len - c->stream_off) {
+      c->stream_discard = true;
+    } else {
+      s->in_use++;
+      uint8_t* d = s->base + c->stream_off + c->stream_written;
+      g.unlock();
+      memcpy(d, src, n);
+      g.lock();
+      if (--s->in_use == 0) e->sink_cv.notify_all();
+    }
+  }
+  c->stream_written += n;
+  c->stream_left -= n;
+  if (c->stream_left == 0) finish_stream(e, c);
+}
+
+// Engine thread: continue the active stream by recv'ing STRAIGHT into
+// the sink region (the kernel's copy is the only receive-side copy).
+// Returns false if the conn died.
+bool stream_recv(Engine* e, Conn* c) {
+  uint8_t scratch[16384];
+  while (c->streaming) {
+    uint8_t* d = nullptr;
+    Sink* s = nullptr;
+    {
+      std::unique_lock<std::mutex> g(e->mu);
+      if (!c->stream_discard) {
+        auto it = e->sinks.find(c->stream_token);
+        s = (it == e->sinks.end()) ? nullptr : it->second;
+        if (!s || s->dead || c->stream_off > s->len ||
+            c->stream_written + c->stream_left >
+                s->len - c->stream_off) {  // wrap-safe, see deposit_copy
+          c->stream_discard = true;
+          s = nullptr;
+        } else {
+          s->in_use++;  // held across ONE bounded recv, released below
+          d = s->base + c->stream_off + c->stream_written;
+        }
+      }
+    }
+    ssize_t r;
+    if (d) {
+      r = recv(c->fd, d, c->stream_left, 0);
+    } else {
+      r = recv(c->fd, scratch,
+               std::min(c->stream_left, (uint64_t)sizeof(scratch)), 0);
+    }
+    if (s) {
+      std::lock_guard<std::mutex> g(e->mu);
+      if (--s->in_use == 0) e->sink_cv.notify_all();
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return errno == EAGAIN || errno == EWOULDBLOCK;
+    }
+    if (r == 0) return false;  // peer died mid-frame
+    c->stream_written += (size_t)r;
+    c->stream_left -= (size_t)r;
+    if (c->stream_left == 0) finish_stream(e, c);
+  }
+  return true;
+}
+
+// Parse complete frames out of c->rbuf, emit EV_FRAME/EV_RAW events.
+// May put the conn into streaming mode (raw deposit frame): the caller
+// then continues the payload via stream_recv.
 bool parse_frames(Engine* e, Conn* c) {
-  while (true) {
+  while (!c->streaming) {
     size_t avail = c->rbuf.size() - c->rpos;
     if (avail < 4) break;
     const uint8_t* p = c->rbuf.data() + c->rpos;
-    uint32_t len = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
-                   ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    uint32_t word = be32(p);
+    bool raw = (word & kRawFlag) != 0;
+    uint32_t len = word & ~kRawFlag;
     if (len > kMaxFrame) return false;
-    if (avail < 4 + (size_t)len) break;
-    uint8_t* body = (uint8_t*)malloc(len ? len : 1);
-    memcpy(body, p + 4, len);
-    c->rpos += 4 + len;
-    push_event(e, CdEvent{c->id, EV_FRAME, len, body, 0});
+    if (!raw) {
+      if (avail < 4 + (size_t)len) break;
+      uint8_t* body = (uint8_t*)malloc(len ? len : 1);
+      memcpy(body, p + 4, len);
+      c->rpos += 4 + len;
+      push_event(e, CdEvent{c->id, EV_FRAME, len, body, 0});
+      continue;
+    }
+    if (len < kRawFixed) return false;
+    if (avail < 4 + 16) break;  // need hlen + token
+    uint32_t hlen = be32(p + 4);
+    if (kRawFixed + (size_t)hlen > len) return false;
+    int64_t token = (int64_t)be64(p + 8);
+    uint64_t payload_len = len - kRawFixed - hlen;
+    if (token == 0) {
+      // inline raw frame: whole body in one event (small payloads,
+      // or peers that don't use deposit sinks)
+      if (avail < 4 + (size_t)len) break;
+      uint8_t* body = (uint8_t*)malloc(len ? len : 1);
+      memcpy(body, p + 4, len);
+      c->rpos += 4 + len;
+      push_event(e, CdEvent{c->id, EV_RAW, len, body,
+                            (int64_t)payload_len});
+      continue;
+    }
+    size_t hdr_total = 4 + kRawFixed + hlen;
+    if (avail < hdr_total) break;
+    // deposit mode: save the header region for the completion event,
+    // then stream the payload into the registered sink
+    uint32_t ehl = kRawFixed + hlen;
+    uint8_t* ehdr = (uint8_t*)malloc(ehl ? ehl : 1);
+    memcpy(ehdr, p + 4, ehl);
+    c->streaming = true;
+    c->stream_discard = false;
+    c->stream_token = token;
+    c->stream_off = be64(p + 16);
+    c->stream_written = 0;
+    c->stream_left = payload_len;
+    c->ev_hdr = ehdr;
+    c->ev_hdr_len = ehl;
+    c->rpos += hdr_total;
+    // payload bytes already buffered behind the header go first
+    size_t have = std::min((uint64_t)(c->rbuf.size() - c->rpos),
+                           payload_len);
+    if (have > 0) {
+      deposit_copy(e, c, c->rbuf.data() + c->rpos, have);
+      c->rpos += have;
+    } else if (payload_len == 0) {
+      finish_stream(e, c);
+    }
   }
   // compact consumed prefix
   if (c->rpos > 0) {
@@ -238,6 +458,12 @@ bool parse_frames(Engine* e, Conn* c) {
 
 bool read_conn(Engine* e, Conn* c) {
   while (true) {
+    if (c->streaming) {
+      // the current raw frame's payload bypasses rbuf entirely
+      if (!stream_recv(e, c)) return false;
+      if (c->streaming) return true;  // EAGAIN mid-stream
+      continue;
+    }
     size_t old = c->rbuf.size();
     c->rbuf.resize(old + kReadChunk);
     ssize_t r = recv(c->fd, c->rbuf.data() + old, kReadChunk, 0);
@@ -250,6 +476,7 @@ bool read_conn(Engine* e, Conn* c) {
     if (r == 0) { c->rbuf.resize(old); return false; }
     c->rbuf.resize(old + (size_t)r);
     if (!parse_frames(e, c)) return false;
+    if (c->streaming) continue;  // payload continues on the socket
     if ((size_t)r < kReadChunk) return true;
   }
 }
@@ -258,6 +485,9 @@ Conn* add_conn(Engine* e, int fd) {
   set_nonblock(fd);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));  // no-op on unix
+  int sb = kSockBuf;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sb, sizeof(sb));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sb, sizeof(sb));
   Conn* c = new Conn();
   c->fd = fd;
   {
@@ -510,8 +740,13 @@ void cd_engine_stop(void* h) {
   e->stop.store(true);
   wake(e);
   e->thr.join();
-  for (auto& kv : e->conns) { close(kv.second->fd); delete kv.second; }
+  for (auto& kv : e->conns) {
+    close(kv.second->fd);
+    if (kv.second->ev_hdr) free(kv.second->ev_hdr);
+    delete kv.second;
+  }
   for (auto& kv : e->listeners) { close(kv.second->fd); delete kv.second; }
+  for (auto& kv : e->sinks) delete kv.second;
   {
     std::lock_guard<std::mutex> g(e->ev_mu);
     for (auto& ev : e->events)
@@ -589,6 +824,79 @@ int64_t cd_send(void* h, int64_t conn, const uint8_t* buf, uint32_t len) {
   }
   wake(e);
   return (int64_t)qb;
+}
+
+// Scatter-gather send: one frame whose header bytes are copied (small)
+// and whose payload is written via writev STRAIGHT from the caller's
+// memory — no copy into the out-queue. The caller must keep `payload`
+// valid until an EV_SENT event delivers `token` (also emitted if the
+// conn dies first). With raw != 0 the length word carries the RAW
+// marker and the receiver gets EV_RAW (header + verbatim payload);
+// with raw == 0 the bytes must parse as one msgpack body (the caller
+// splices payload into a msgpack bin it began in `hdr`).
+// Returns queued bytes, -1 if the conn is gone, -2 if the frame is
+// over the 1 GiB cap.
+int64_t cd_send_iov(void* h, int64_t conn, const uint8_t* hdr,
+                    uint32_t hdr_len, const uint8_t* payload,
+                    uint64_t payload_len, int32_t raw, int64_t token) {
+  Engine* e = (Engine*)h;
+  uint64_t total = (uint64_t)hdr_len + payload_len;
+  if (total > kMaxFrame) return -2;
+  uint32_t word = (uint32_t)total | (raw ? kRawFlag : 0u);
+  size_t qb;
+  {
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->conns.find(conn);
+    if (it == e->conns.end()) return -1;
+    Conn* c = it->second;
+    OutBuf b;
+    b.data.resize(4 + hdr_len);
+    b.data[0] = (uint8_t)(word >> 24);
+    b.data[1] = (uint8_t)(word >> 16);
+    b.data[2] = (uint8_t)(word >> 8);
+    b.data[3] = (uint8_t)word;
+    if (hdr_len) memcpy(b.data.data() + 4, hdr, hdr_len);
+    b.ext = payload;
+    b.ext_len = (size_t)payload_len;
+    b.token = token;
+    c->outq.push_back(std::move(b));
+    c->out_bytes += 4 + total;
+    qb = c->out_bytes;
+  }
+  wake(e);
+  return (int64_t)qb;
+}
+
+// Register a deposit region for raw frames carrying `token`: their
+// payloads stream straight off the socket into base[off..]. The caller
+// keeps `base` valid (and its owner pinned) until cd_sink_unregister
+// returns. Returns 0, or -1 if the token is already registered.
+int cd_sink_register(void* h, int64_t token, uint8_t* base, uint64_t len) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  if (token == 0 || e->sinks.count(token)) return -1;
+  Sink* s = new Sink();
+  s->base = base;
+  s->len = len;
+  e->sinks[token] = s;
+  return 0;
+}
+
+// Unregister a deposit region. BLOCKS until any in-flight engine write
+// into it finishes (each is one bounded recv/memcpy), so on return the
+// memory can be freed/aborted race-free; late frames for the token are
+// discarded by the engine. Returns 0, or -1 if unknown.
+int cd_sink_unregister(void* h, int64_t token) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> g(e->mu);
+  auto it = e->sinks.find(token);
+  if (it == e->sinks.end()) return -1;
+  Sink* s = it->second;
+  s->dead = true;
+  while (s->in_use > 0) e->sink_cv.wait(g);
+  e->sinks.erase(token);
+  delete s;
+  return 0;
 }
 
 int cd_close(void* h, int64_t conn) {

@@ -213,6 +213,196 @@ int main() {
            (long long)buffered, sent_frames);
   }
 
+  // ---- 7: raw frames + scatter-gather send (cd_send_iov) ----
+  // Covers: EV_RAW delivery with intact header+payload, EV_SENT token
+  // completion for the zero-copy buffer, dribbled raw frames
+  // (reassembly), oversized raw length rejection, and EV_SENT emission
+  // for buffers abandoned by a dying conn.
+  {
+    void* h = cd_engine_new();
+    int32_t port = 0;
+    cd_listen(h, addr.c_str(), &port);
+
+    // engine-to-engine: connect a second engine as the sender so the
+    // writev path (including partial writes of the external iov) runs
+    void* hs = cd_engine_new();
+    int64_t cid = cd_connect(hs, addr.c_str());
+    assert(cid > 0);
+
+    const size_t PLEN = 3 * 1024 * 1024;
+    std::vector<uint8_t> payload(PLEN);
+    for (size_t i = 0; i < PLEN; i++) payload[i] = (uint8_t)(i * 31 + 7);
+    // header: [u32 hlen][u64 token][u64 off][hlen bytes] — the wire
+    // layer's raw-body prefix (token 0 = inline delivery)
+    std::string hmeta = "{\"off\":0}";
+    auto mk_hdr = [&](int64_t token, uint64_t off) {
+      std::vector<uint8_t> h(20 + hmeta.size(), 0);
+      uint32_t hl = (uint32_t)hmeta.size();
+      h[0] = hl >> 24; h[1] = hl >> 16; h[2] = hl >> 8; h[3] = hl;
+      for (int i = 0; i < 8; i++) {
+        h[4 + i] = (uint8_t)((uint64_t)token >> (56 - 8 * i));
+        h[12 + i] = (uint8_t)(off >> (56 - 8 * i));
+      }
+      memcpy(h.data() + 20, hmeta.data(), hmeta.size());
+      return h;
+    };
+    std::vector<uint8_t> hdr = mk_hdr(0, 0);
+
+    const int NRAW = 8;
+    for (int i = 0; i < NRAW; i++) {
+      int64_t q = cd_send_iov(hs, cid, hdr.data(), (uint32_t)hdr.size(),
+                              payload.data(), PLEN, 1, 1000 + i);
+      assert(q > 0);
+    }
+    // oversized raw frame rejected without queueing
+    assert(cd_send_iov(hs, cid, hdr.data(), (uint32_t)hdr.size(),
+                       payload.data(), (uint64_t)kMaxFrame + 1, 1, 0) == -2);
+
+    // receiver: NRAW EV_RAW events with byte-exact body
+    CdEvent evs[32];
+    int raw_seen = 0, waited = 0;
+    while (raw_seen < NRAW && waited < 10000) {
+      int n = cd_poll(h, 50, evs, 32);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_RAW) {
+          assert(evs[i].len == hdr.size() + PLEN);
+          assert(memcmp(evs[i].data, hdr.data(), hdr.size()) == 0);
+          assert(memcmp(evs[i].data + hdr.size(), payload.data(), PLEN) == 0);
+          raw_seen++;
+          cd_free(h, evs[i].data);
+        } else if (evs[i].kind == EV_FRAME) {
+          cd_free(h, evs[i].data);
+        }
+      }
+    }
+    assert(raw_seen == NRAW);
+    // sender: every zero-copy buffer completion delivered
+    int sent_seen = 0;
+    waited = 0;
+    bool tok_ok = true;
+    while (sent_seen < NRAW && waited < 10000) {
+      int n = cd_poll(hs, 50, evs, 32);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_SENT) {
+          if (evs[i].aux < 1000 || evs[i].aux >= 1000 + NRAW) tok_ok = false;
+          sent_seen++;
+        } else if (evs[i].kind == EV_FRAME || evs[i].kind == EV_RAW) {
+          cd_free(hs, evs[i].data);
+        }
+      }
+    }
+    assert(sent_seen == NRAW && tok_ok);
+
+    // dribbled raw frame over a plain socket: reassembly across reads
+    int fd = raw_connect_unix(path);
+    std::vector<uint8_t> wire;
+    uint32_t word = (uint32_t)(hdr.size() + 64) | 0x80000000u;
+    wire.push_back(word >> 24); wire.push_back(word >> 16);
+    wire.push_back(word >> 8); wire.push_back(word);
+    wire.insert(wire.end(), hdr.begin(), hdr.end());
+    for (int i = 0; i < 64; i++) wire.push_back((uint8_t)i);
+    for (size_t i = 0; i < wire.size(); i++) send_all(fd, wire.data() + i, 1);
+    int got_raw = 0;
+    waited = 0;
+    while (!got_raw && waited < 5000) {
+      int n = cd_poll(h, 50, evs, 32);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_RAW) {
+          assert(evs[i].len == hdr.size() + 64);
+          got_raw++;
+          cd_free(h, evs[i].data);
+        } else if (evs[i].kind == EV_FRAME) {
+          cd_free(h, evs[i].data);
+        }
+      }
+    }
+    assert(got_raw == 1);
+    close(fd);
+
+    // abandoned zero-copy buffer: queue a send, close the conn before
+    // it can flush a second giant payload — EV_SENT must still arrive
+    // for every token (no leaked owner pin)
+    int64_t cid2 = cd_connect(hs, addr.c_str());
+    std::vector<uint8_t> big(8 * 1024 * 1024, 0xAB);
+    cd_send_iov(hs, cid2, hdr.data(), (uint32_t)hdr.size(),
+                big.data(), big.size(), 1, 7001);
+    cd_send_iov(hs, cid2, hdr.data(), (uint32_t)hdr.size(),
+                big.data(), big.size(), 1, 7002);
+    cd_close(hs, cid2);
+    int sent2 = 0, closed2 = 0;
+    waited = 0;
+    while ((sent2 < 2 || !closed2) && waited < 10000) {
+      int n = cd_poll(hs, 50, evs, 32);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_SENT &&
+            (evs[i].aux == 7001 || evs[i].aux == 7002)) sent2++;
+        else if (evs[i].kind == EV_CLOSED) closed2++;
+        else if (evs[i].kind == EV_FRAME || evs[i].kind == EV_RAW)
+          cd_free(hs, evs[i].data);
+      }
+    }
+    assert(sent2 == 2 && closed2 >= 1);
+
+    // close() races the flush: any giant frame that DID reach the wire is
+    // now queued on the receiver as a full-body EV_RAW — drain until quiet
+    // so the header-only asserts below see only deposit events
+    for (int quiet = 0; quiet < 4;) {
+      int n = cd_poll(h, 50, evs, 32);
+      if (!n) { quiet++; continue; }
+      quiet = 0;
+      for (int i = 0; i < n; i++)
+        if (evs[i].kind == EV_FRAME || evs[i].kind == EV_RAW)
+          cd_free(h, evs[i].data);
+    }
+
+    // deposit sinks: payload streams straight into the registered
+    // region (receive-into-place); header-only EV_RAW carries the
+    // deposited count; unregistered/oob tokens discard (aux == -1)
+    std::vector<uint8_t> region(2 * PLEN, 0);
+    assert(cd_sink_register(h, 42, region.data(), region.size()) == 0);
+    assert(cd_sink_register(h, 42, region.data(), region.size()) == -1);
+    int64_t cid3 = cd_connect(hs, addr.c_str());
+    auto dh = mk_hdr(42, PLEN);  // deposit at offset PLEN
+    cd_send_iov(hs, cid3, dh.data(), (uint32_t)dh.size(),
+                payload.data(), PLEN, 1, 0);
+    auto dh_oob = mk_hdr(42, 2 * PLEN - 5);  // overruns the region
+    cd_send_iov(hs, cid3, dh_oob.data(), (uint32_t)dh_oob.size(),
+                payload.data(), PLEN, 1, 0);
+    auto dh_unk = mk_hdr(777, 0);  // never registered
+    cd_send_iov(hs, cid3, dh_unk.data(), (uint32_t)dh_unk.size(),
+                payload.data(), PLEN, 1, 0);
+    int dep_ok = 0, dep_discard = 0;
+    waited = 0;
+    while (dep_ok + dep_discard < 3 && waited < 10000) {
+      int n = cd_poll(h, 50, evs, 32);
+      if (!n) { waited += 50; continue; }
+      for (int i = 0; i < n; i++) {
+        if (evs[i].kind == EV_RAW) {
+          assert(evs[i].len == dh.size());  // header-only event
+          if (evs[i].aux == (int64_t)PLEN) dep_ok++;
+          else if (evs[i].aux == -1) dep_discard++;
+          cd_free(h, evs[i].data);
+        } else if (evs[i].kind == EV_FRAME) {
+          cd_free(h, evs[i].data);
+        }
+      }
+    }
+    assert(dep_ok == 1 && dep_discard == 2);
+    assert(memcmp(region.data() + PLEN, payload.data(), PLEN) == 0);
+    // the region before the deposit offset stayed untouched
+    for (size_t i = 0; i < 1024; i++) assert(region[i] == 0);
+    assert(cd_sink_unregister(h, 42) == 0);
+    assert(cd_sink_unregister(h, 42) == -1);
+
+    cd_engine_stop(hs);
+    cd_engine_stop(h);
+    printf("raw+iov ok\n");
+  }
+
   unlink(path);
   printf("conduit stress ok\n");
   return 0;

@@ -341,8 +341,11 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
         system_config={
             # 8KB chunks make the 96MB pull take seconds (thousands of
             # chunk RPCs) — the gating this test guards against must be
-            # DETECTABLE, not hidden by a fast loopback transfer
+            # DETECTABLE, not hidden by a fast loopback transfer (the
+            # same-host shm fast path is likewise disabled)
             "object_transfer_chunk_bytes": 8 * 1024,
+            "object_transfer_window": 1,
+            "object_transfer_same_host_shm": False,
         },
     )
     try:
@@ -351,7 +354,7 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
 
         @ray_tpu.remote(num_cpus=1, resources={"other": 0.01})
         def make_big():
-            return np.zeros(12_000_000, np.float64)  # 96 MB on other node
+            return np.zeros(3_000_000, np.float64)  # 24 MB on other node
 
         big_ref = make_big.remote()
         ray_tpu.wait([big_ref], timeout=60, fetch_local=False)
@@ -369,7 +372,7 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
         fast = quick.remote()
         assert ray_tpu.get(fast, timeout=60) == "fast"
         fast_done = time.monotonic() - t0
-        assert ray_tpu.get(slow, timeout=180) == 96_000_000
+        assert ray_tpu.get(slow, timeout=180) == 24_000_000
         slow_done = time.monotonic() - t0
         # the transfer must have been slow enough to be a meaningful gate,
         # and the quick task must have run DURING it, not after it

@@ -29,8 +29,13 @@ def _build_and_run(tmp_path, extra_flags):
     assert run.returncode == 0, report
     assert "WARNING: ThreadSanitizer" not in report, report
     assert "ERROR: AddressSanitizer" not in report, report
+    assert "runtime error" not in report, report  # UBSan findings
     assert "conduit stress ok" in run.stdout
     assert "high-water backpressure ok" in run.stdout
+    # zero-copy scatter-gather + raw-frame section (EV_RAW bodies,
+    # EV_SENT tokens incl. abandoned-buffer delivery, dribbled raw
+    # reassembly, oversized raw rejection) must have run
+    assert "raw+iov ok" in run.stdout
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
@@ -42,6 +47,13 @@ def test_conduit_malformed_corpus_plain(tmp_path):
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
 def test_conduit_malformed_corpus_asan(tmp_path):
     _build_and_run(tmp_path, ["-fsanitize=address"])
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_conduit_malformed_corpus_ubsan(tmp_path):
+    _build_and_run(tmp_path, ["-fsanitize=undefined",
+                              "-fno-sanitize-recover=all"])
 
 
 @pytest.mark.slow
