@@ -497,6 +497,34 @@ def main():
                  "floor": round(mfu_floor, 4)}
             )
 
+    # ---- raylint gate: the static invariants (tools/raylint, DESIGN.md
+    # "Enforced invariants") are part of the bench contract — a new
+    # finding fails the run exactly like a perf-floor violation, and
+    # the count lands in the JSON detail so regressions show in the
+    # BENCH_r*.json trajectory.
+    try:
+        from tools.raylint import lint_paths
+
+        _lint = lint_paths(
+            ["ray_tpu", "tests", "tools"],
+            root=os.path.dirname(os.path.abspath(__file__)),
+        )
+        raylint_findings = len(_lint["findings"]) + len(_lint["errors"])
+        raylint_detail = {
+            "findings": raylint_findings,
+            "suppressed": _lint["suppressed"],
+            "counts": _lint["counts"],
+        }
+    except Exception as e:  # a broken linter must fail loudly, not pass
+        raylint_findings = -1
+        raylint_detail = {"error": str(e)[:160]}
+    if raylint_findings != 0:
+        violations.append({
+            "metric": "raylint_findings",
+            "value": raylint_findings,
+            "floor": 0,
+        })
+
     out = {
         "metric": metric,
         "value": round(mfu, 4),
@@ -516,6 +544,8 @@ def main():
             "inference": inference,
             "serving": serving,
             "micro": micro,
+            "raylint_findings": raylint_findings,
+            "raylint": raylint_detail,
             "floor_violations": violations,
         },
     }

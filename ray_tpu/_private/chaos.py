@@ -56,6 +56,7 @@ import collections
 import hashlib
 import json
 import os
+import random
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -254,3 +255,26 @@ def uninstall():
 
 def plane() -> Optional["ChaosPlane"]:
     return _PLANE
+
+
+def replay_rng(tag: str = "") -> "random.Random":
+    """RNG for chaos-replayed code paths (peer shuffles, backoff jitter,
+    spillback target picks).
+
+    With a plane installed, the returned generator is seeded from the
+    plane's seed + ``tag`` — replaying a workload under the same chaos
+    seed reproduces the same draws, so the fault schedule meets the same
+    traffic (raylint R4 enforces that replay-sensitive code draws from
+    here, never from the OS-seeded ``random`` module). Distinct tags
+    (e.g. per node id) keep processes decorrelated, which is what the
+    jitter call sites need. Without a plane it is OS-seeded — plain
+    production behavior.
+    """
+    pl = _PLANE
+    if pl is None:
+        return random.Random()
+    key = hashlib.blake2b(
+        tag.encode(), digest_size=8,
+        key=pl.seed.to_bytes(8, "big", signed=True),
+    ).digest()
+    return random.Random(int.from_bytes(key, "big"))

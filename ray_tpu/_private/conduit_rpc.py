@@ -178,8 +178,11 @@ class ConduitConnection:
             raise rpc.SendError(str(e)) from e
 
     def _send_raw(self, body: bytes, copies: int):
+        # chaos-plane internal: delivers frames send_frame's gate already
+        # decided to duplicate/delay — gating again would double-decide
         for _ in range(copies):
             try:
+                # raylint: disable=R3 — post-gate delivery (see above)
                 self.engine.send(self.conn_id, body)
             except ConnectionError:
                 return  # conn died while the frame was "in flight"
@@ -232,8 +235,11 @@ class ConduitConnection:
             raise
 
     def _send_iov_copies(self, header: bytes, data: bytes, copies: int):
+        # chaos-plane internal: post-gate raw-frame delivery (see
+        # _send_raw) — the duplicate/delay decision was already made
         for _ in range(copies):
             try:
+                # raylint: disable=R3 — post-gate delivery (see above)
                 self.engine.send_iov(self.conn_id, header, data, raw=True)
             except Exception:
                 return

@@ -885,7 +885,9 @@ class CoreWorker:
     def _read_plasma(self, ref: ObjectRef, requested_pull, wake=None,
                      listening=None):
         # writable=True: the pre-3.12 pin carrier (ctypes.from_buffer) needs
-        # a writable source; unpack() re-wraps every consumer view read-only.
+        # a writable source; unpack() re-wraps every consumer view read-only,
+        # so the writable view never escapes this function.
+        # raylint: disable=R5 — feeds unpack()'s _pinned_buffer path only
         view = self.store.get(ref.id, timeout=0, writable=True)
         if view is not None:
             # The store ref taken by get() is owned by `pin`: it lives until

@@ -21,6 +21,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Set
 
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.protocol import NodeInfo, TaskSpec
@@ -233,6 +234,9 @@ class GcsServer:
         # re-established by raylets re-registering.
         self.storage_path = storage_path
         self._dirty = False
+        # Seeded under an installed chaos plane: placement picks replay
+        # identically for the same chaos seed (raylint R4).
+        self._rng = _chaos.replay_rng("gcs")
         from ray_tpu._private.conduit_rpc import make_server
 
         self.server = make_server(
@@ -771,9 +775,7 @@ class GcsServer:
             ]
             # Randomize so a full bundle's node is not retried exclusively
             # while another bundle (idx=-1) has free capacity.
-            import random
-
-            return random.choice(alive) if alive else None
+            return self._rng.choice(alive) if alive else None
         if isinstance(strategy, (list, tuple)) and strategy and (
             strategy[0] == "affinity"
         ):

@@ -170,9 +170,15 @@ class SharedMemoryStore:
             raise RuntimeError(f"seal failed for {oid.hex()}")
 
     def abort(self, oid: ObjectID):
+        """Abandon a created-but-unsealed buffer (call from the flow that
+        created it). The native abort only marks the entry dead — the
+        block is freed when the creator's reference (held since
+        ``create_buffer``) is released, so a concurrent writer can never
+        race the free — which is why the release happens here too."""
         if not self._base:
             return
-        self._lib.rt_store_abort(self._base, oid.binary())
+        if self._lib.rt_store_abort(self._base, oid.binary()) == 0:
+            self._lib.rt_store_release(self._base, oid.binary())
 
     def put(self, oid: ObjectID, data) -> None:
         mv = memoryview(data)

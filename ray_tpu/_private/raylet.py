@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -211,6 +212,10 @@ class Raylet:
         self.labels = labels or {}
         self.total_resources = dict(resources)
         self.available = dict(resources)
+        # Seeded under an installed chaos plane so replays reproduce
+        # peer shuffles / jitter / spillback picks (raylint R4); the
+        # node-id tag keeps raylets decorrelated.
+        self._rng = _chaos.replay_rng("raylet|" + node_id.hex())
         from ray_tpu._private.conduit_rpc import make_server
 
         self.server = make_server(
@@ -338,8 +343,6 @@ class Raylet:
         across attempts (server-side dedup applies the mutation once),
         exponential backoff + jitter between them — a chaos-dropped frame
         costs one timeout, not the registration."""
-        import random as _random
-
         rid = os.urandom(16)
         backoff = 0.2
         for i in range(attempts):
@@ -353,7 +356,9 @@ class Raylet:
             except (asyncio.TimeoutError, ConnectionError, OSError):
                 if i == attempts - 1 or self._stopping:
                     raise
-                await asyncio.sleep(backoff * (0.5 + _random.random() * 0.5))
+                await asyncio.sleep(
+                    backoff * (0.5 + self._rng.random() * 0.5)
+                )
                 backoff = min(backoff * 2.0, 2.0)
 
     async def _register_with_gcs(self):
@@ -414,8 +419,6 @@ class Raylet:
         asyncio.get_running_loop().create_task(self._gcs_reconnect_loop())
 
     async def _gcs_reconnect_loop(self):
-        import random as _random
-
         if getattr(self, "_gcs_reconnecting", False):
             return
         self._gcs_reconnecting = True
@@ -430,7 +433,9 @@ class Raylet:
                 except Exception:
                     # exponential backoff + jitter: N raylets must not
                     # hammer a just-restarting GCS in lockstep
-                    await asyncio.sleep(backoff * (0.5 + _random.random()))
+                    await asyncio.sleep(
+                        backoff * (0.5 + self._rng.random())
+                    )
                     backoff = min(backoff * 2.0, 5.0)
         finally:
             self._gcs_reconnecting = False
@@ -988,8 +993,6 @@ class Raylet:
         assigned the bundle to. Parity: PlacementGroupSchedulingStrategy
         consulting bundle locations (reference bundle_scheduling_policy.h:31).
         """
-        import random
-
         from ray_tpu._private.protocol import parse_pg_strategy
 
         pg_id, want_idx = parse_pg_strategy(summary["strategy"])
@@ -1043,7 +1046,7 @@ class Raylet:
                 ]
                 remote = [c for c in cands if c != self.node_id]
                 if remote and self.node_id not in cands:
-                    target = random.choice(remote)
+                    target = self._rng.choice(remote)
                     node = self.cluster_nodes.get(target.hex())
                     if node and node.get("alive", True):
                         return {"spillback": node["raylet_addr"]}
@@ -1289,6 +1292,7 @@ class Raylet:
             # grant landed: the done future then holds a live lease (worker +
             # resources acquired) that must be released, not leaked.
             if fut.done() and not fut.cancelled() and fut.exception() is None:
+                # raylint: disable=R1 — asyncio future, done()-guarded above
                 stale = self.leases.pop(fut.result()["lease_id"], None)
                 if stale is not None:
                     self._release_alloc(stale.alloc, stale.resources)
@@ -1357,43 +1361,54 @@ class Raylet:
     # (services.py:971). Tails THIS raylet's worker log files and forwards
     # new lines through the GCS "logs" pubsub channel.
 
+    def _scan_worker_logs(self, log_dir: str, offsets: Dict[str, int],
+                          ever_hex: Set[str]) -> List[Dict]:
+        """One directory scan + tail read per monitor tick. Runs in a
+        thread (asyncio.to_thread): listdir/getsize/read are real disk
+        I/O and a slow/contended disk must not stall the event loop that
+        serves heartbeats and pulls (raylint R1). ``ever_hex`` is a
+        loop-side snapshot of self._ever_workers — the live set mutates
+        on the event loop while this thread iterates."""
+        my_workers_prefix = "worker-"
+        batch: List[Dict] = []
+        if not os.path.isdir(log_dir):
+            return batch
+        for fname in os.listdir(log_dir):
+            if not fname.startswith(my_workers_prefix):
+                continue
+            wid_hex = fname[len(my_workers_prefix):-4]
+            # tail workers that EVER belonged to this raylet (a dead
+            # worker's final traceback is the most diagnostic output)
+            if not any(h.startswith(wid_hex) for h in ever_hex):
+                continue
+            path = os.path.join(log_dir, fname)
+            size = os.path.getsize(path)
+            off = offsets.get(path, 0)
+            if size <= off:
+                continue
+            with open(path, "rb") as f:
+                f.seek(off)
+                data = f.read(min(size - off, 256 * 1024))
+            offsets[path] = off + len(data)
+            lines = data.decode(errors="replace").splitlines()
+            if lines:
+                batch.append(
+                    {"worker": wid_hex,
+                     "node": self.node_id.hex()[:12],
+                     "lines": lines}
+                )
+        return batch
+
     async def _log_monitor_loop(self):
         offsets: Dict[str, int] = {}
         log_dir = os.path.join(self.session_dir, "logs")
-        my_workers_prefix = "worker-"
         while not self._stopping:
             await asyncio.sleep(0.5)
             try:
-                batch = []
-                if not os.path.isdir(log_dir):
-                    continue
-                for fname in os.listdir(log_dir):
-                    if not fname.startswith(my_workers_prefix):
-                        continue
-                    wid_hex = fname[len(my_workers_prefix):-4]
-                    # tail workers that EVER belonged to this raylet (a dead
-                    # worker's final traceback is the most diagnostic output)
-                    if not any(
-                        w.hex().startswith(wid_hex)
-                        for w in self._ever_workers
-                    ):
-                        continue
-                    path = os.path.join(log_dir, fname)
-                    size = os.path.getsize(path)
-                    off = offsets.get(path, 0)
-                    if size <= off:
-                        continue
-                    with open(path, "rb") as f:
-                        f.seek(off)
-                        data = f.read(min(size - off, 256 * 1024))
-                    offsets[path] = off + len(data)
-                    lines = data.decode(errors="replace").splitlines()
-                    if lines:
-                        batch.append(
-                            {"worker": wid_hex,
-                             "node": self.node_id.hex()[:12],
-                             "lines": lines}
-                        )
+                ever_hex = {w.hex() for w in self._ever_workers}
+                batch = await asyncio.to_thread(
+                    self._scan_worker_logs, log_dir, offsets, ever_hex
+                )
                 if batch and self.gcs and not self.gcs.closed:
                     await self.gcs.call_async("publish_logs", batch,
                                               timeout=10)
@@ -1635,9 +1650,10 @@ class Raylet:
         """One logical pull: locate holders, probe their metas, then run
         a windowed multi-peer striped fetch. A failed attempt (peer died
         or timed out mid-pull) aborts the partial buffer ONCE and retries
-        with fresh locations up to ``object_transfer_retries`` times."""
-        import random as _random
-
+        with fresh locations up to ``object_transfer_retries`` times.
+        Chaos-replay-deterministic: source-order shuffles draw from the
+        seeded per-raylet RNG so a replayed fault schedule meets the
+        same pull traffic (raylint R4 guards this)."""
         retries = max(1, int(GLOBAL_CONFIG.object_transfer_retries))
         stripe = max(1, int(GLOBAL_CONFIG.object_transfer_stripe_peers))
         trace = os.environ.get("RAYTPU_TRANSFER_TRACE")
@@ -1662,7 +1678,7 @@ class Raylet:
             # randomize the source order so an N-node broadcast forms a
             # tree (each completed pull registers a new location) instead
             # of every node hammering the origin (push_manager.h:30 role)
-            _random.shuffle(cands)
+            self._rng.shuffle(cands)
             if GLOBAL_CONFIG.object_transfer_same_host_shm:
                 for node in cands:
                     if await self._pull_same_host_shm(oid, node):
@@ -1961,13 +1977,29 @@ class Raylet:
                 )
             return not state["failed"]
 
-        survivors = list(peers)
-        while ranges and survivors:
-            done_before = done[0]
-            results = await asyncio.gather(*(run_peer(a) for a in survivors))
-            survivors = [a for a, ok in zip(survivors, results) if ok]
-            if done[0] == done_before:
-                break  # zero chunks landed this round: don't spin
+        try:
+            survivors = list(peers)
+            while ranges and survivors:
+                done_before = done[0]
+                results = await asyncio.gather(
+                    *(run_peer(a) for a in survivors)
+                )
+                survivors = [a for a, ok in zip(survivors, results) if ok]
+                if done[0] == done_before:
+                    break  # zero chunks landed this round: don't spin
+        except BaseException:
+            # cancellation (raylet shutdown) or an unexpected fault must
+            # not leak the registered sink (engine-pinned store buffer),
+            # the _transfers entry, or the unsealed partial buffer
+            self._transfers.pop(token, None)
+            if native_sink:
+                _conduit.Engine.get().sink_unregister(token)
+            sink_target.close()
+            try:
+                self.store.abort(oid)
+            except Exception:
+                pass
+            raise
 
         self._transfers.pop(token, None)
         if native_sink:
@@ -2107,6 +2139,23 @@ class Raylet:
                 except Exception:
                     break  # conn died; on_sent already fired
                 served += 1
+                # asyncio fallback only: its transport BUFFERS the
+                # payload at write() and fires on_sent immediately, so
+                # the pacing semaphore bounds nothing — drain past the
+                # high-water mark or a slow puller piles the whole
+                # window into the writer buffer. (The conduit engine
+                # needs no drain: its EV_SENT fires when writev really
+                # flushed, so the semaphore paces natively.)
+                writer = getattr(conn, "writer", None)
+                if writer is not None and (
+                    writer.transport.get_write_buffer_size()
+                    > rpc._DRAIN_HIGH_WATER
+                ):
+                    try:
+                        async with conn._write_lock:
+                            await writer.drain()
+                    except Exception:
+                        break  # conn died mid-drain
         finally:
             unref()
         return {"served": served}
@@ -2149,9 +2198,21 @@ class Raylet:
             view = self.store.get(oid, timeout=0)
         if view is None:
             return None
+        off, n = int(off), int(n)
+        nbytes = view.nbytes
+        if off < 0 or n < 0 or off + n > nbytes:
+            # same validation as the batch endpoint: a malformed range
+            # must produce a clean error reply, not a negative-index
+            # slice of the wrong bytes (and no pin/stat leak)
+            view.release()
+            self.store.release(oid)
+            raise ValueError(
+                f"chunk range [{off}, {off + n}) outside object of "
+                f"{nbytes} bytes"
+            )
         await self._outbound_sem.acquire()
         self._outbound_chunks += 1
-        self._transfer_bytes_out += int(n)
+        self._transfer_bytes_out += n
         sub = view[off : off + n]
 
         def on_sent():
@@ -2185,9 +2246,17 @@ class Raylet:
         if view is None:
             return None
         try:
+            off, n = int(off), int(n)
+            if off < 0 or n < 0 or off + n > view.nbytes:
+                # same validation as the raw/batch endpoints: negative
+                # off would silently serve bytes from the object's END
+                raise ValueError(
+                    f"chunk range [{off}, {off + n}) outside object "
+                    f"of {view.nbytes} bytes"
+                )
             async with self._outbound_sem:
                 self._outbound_chunks += 1
-                self._transfer_bytes_out += int(n)
+                self._transfer_bytes_out += n
                 return bytes(view[off : off + n])
         finally:
             view.release()
@@ -2258,6 +2327,8 @@ class Raylet:
             workers[wid.hex()[:12]] = ws
         mem_total = mem_avail = None
         try:
+            # procfs: kernel-memory read, never touches disk — fast
+            # raylint: disable=R1 — /proc read, not real file I/O
             with open("/proc/meminfo") as f:
                 mi = dict(
                     line.split(":", 1) for line in f.read().splitlines()
@@ -2293,12 +2364,18 @@ class Raylet:
             return {"error": f"unknown proc {proc!r}", "known":
                     sorted(known)}
         path = os.path.join(self.session_dir, "logs", f"{proc}.log")
-        try:
+
+        def read_tail():
+            # thread (to_thread): up to 4 MB off disk must not stall the
+            # event loop serving heartbeats/pulls (raylint R1)
             with open(path, "rb") as f:
                 f.seek(0, os.SEEK_END)
                 size = f.tell()
                 f.seek(max(0, size - tail))
-                data = f.read()
+                return size, f.read()
+
+        try:
+            size, data = await asyncio.to_thread(read_tail)
             return {"proc": proc, "size": size,
                     "data": data.decode("utf-8", "replace")}
         except FileNotFoundError:

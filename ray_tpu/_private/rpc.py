@@ -28,7 +28,6 @@ import collections
 import itertools
 import logging
 import os
-import random
 import threading
 import time
 import traceback
@@ -467,6 +466,8 @@ class Connection:
         if self._cork:
             buf, self._cork = self._cork, bytearray()
             if not (self._closed or self.writer.is_closing()):
+                # every corked frame passed the gate in send_notify_corked
+                # raylint: disable=R3 — flush of already-gated frames
                 self.writer.write(bytes(buf))
 
     def add_close_callback(self, cb: Callable[["Connection"], None]):
@@ -654,6 +655,15 @@ class Client:
         self._name = name
         self._reconnect_lock = threading.Lock()
         self._closed_by_user = False
+        # backoff jitter: seeded under an installed chaos plane so a
+        # replayed fault schedule sees the same retry timing (raylint
+        # R4). The pid decorrelates processes whose clients share a
+        # name (every raylet's GCS client is "raylet->gcs"): without
+        # it, N seeded raylets would retry a restarted GCS in lockstep
+        # — the thundering herd the jitter exists to prevent.
+        self._rng = _chaos.replay_rng(
+            f"rpc-client|{name or addr}|{os.getpid()}"
+        )
         # called with this Client after a successful reconnect (e.g. to
         # replay pubsub subscriptions the restarted server lost)
         self.on_reconnect = None
@@ -732,7 +742,13 @@ class Client:
         while True:
             attempt_timeout = min(cap, 1.0 * (1 << min(attempt, 6)))
             if timeout is not None:
-                attempt_timeout = min(attempt_timeout, timeout)
+                # clamp to the REMAINING budget, not the original value:
+                # an attempt starting at deadline-2s with attempt_timeout
+                # 5s would overshoot the promised TOTAL bound by 3s
+                attempt_timeout = min(
+                    attempt_timeout, timeout,
+                    max(0.05, deadline - time.monotonic()),
+                )
             attempt += 1
             try:
                 try:
@@ -757,7 +773,7 @@ class Client:
                     raise
                 if conn_failures >= 4 or time.monotonic() + backoff > deadline:
                     raise
-                time.sleep(backoff * (0.5 + random.random() * 0.5))
+                time.sleep(backoff * (0.5 + self._rng.random() * 0.5))
                 backoff = min(backoff * 2.0, 2.0)
 
     def notify(self, method: str, data: Any = None):

@@ -916,8 +916,19 @@ int cd_poll(void* h, int timeout_ms, CdEvent* out, int max) {
   Engine* e = (Engine*)h;
   std::unique_lock<std::mutex> g(e->ev_mu);
   if (e->events.empty() && timeout_ms > 0) {
-    e->ev_cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
-                      [&] { return !e->events.empty(); });
+    // wait_until against system_clock, NOT wait_for: libstdc++ lowers
+    // wait_for to pthread_cond_clockwait (CLOCK_MONOTONIC), which older
+    // libtsan does not intercept — TSan then misses the internal
+    // unlock/relock of ev_mu and reports bogus "double lock of a mutex"
+    // plus data races on everything ev_mu guards (the seed-era red TSan
+    // gate). The system_clock overload compiles to the intercepted
+    // pthread_cond_timedwait; behavior is identical for this bounded
+    // poll (a wall-clock step just ends one poll early/late).
+    e->ev_cv.wait_until(
+        g,
+        std::chrono::system_clock::now() +
+            std::chrono::milliseconds(timeout_ms),
+        [&] { return !e->events.empty(); });
   }
   int n = 0;
   while (n < max && !e->events.empty()) {

@@ -348,15 +348,26 @@ int main() {
     assert(sent2 == 2 && closed2 >= 1);
 
     // close() races the flush: any giant frame that DID reach the wire is
-    // now queued on the receiver as a full-body EV_RAW — drain until quiet
-    // so the header-only asserts below see only deposit events
-    for (int quiet = 0; quiet < 4;) {
-      int n = cd_poll(h, 50, evs, 32);
-      if (!n) { quiet++; continue; }
-      quiet = 0;
-      for (int i = 0; i < n; i++)
-        if (evs[i].kind == EV_FRAME || evs[i].kind == EV_RAW)
-          cd_free(h, evs[i].data);
+    // now queued on the receiver as a full-body EV_RAW. EOF is ordered
+    // after a conn's bytes, so once the receiver has seen EV_CLOSED for
+    // both conns closed so far (the dribble socket and cid2) nothing
+    // stale can still arrive — drain to that point plus a short quiet
+    // tail. (A bare time-based quiet window flaked under TSan: the
+    // instrumented engine can stall past any fixed gap mid-ingest of an
+    // abandoned giant, leaking it into the header-only asserts below.)
+    {
+      int closed_h = 0, waited = 0;
+      for (int quiet = 0; (closed_h < 2 || quiet < 2) && waited < 30000;) {
+        int n = cd_poll(h, 100, evs, 32);
+        if (!n) { quiet++; waited += 100; continue; }
+        quiet = 0;
+        for (int i = 0; i < n; i++) {
+          if (evs[i].kind == EV_CLOSED) closed_h++;
+          else if (evs[i].kind == EV_FRAME || evs[i].kind == EV_RAW)
+            cd_free(h, evs[i].data);
+        }
+      }
+      assert(closed_h == 2);
     }
 
     // deposit sinks: payload streams straight into the registered

@@ -443,7 +443,12 @@ int rt_store_seal(void* base, const uint8_t* id) {
   Header* h = H(base);
   lock(h);
   Entry* e = find_entry(base, id, false);
-  if (!e || e->state != ENTRY_CREATED) { unlock(h); return -1; }
+  // An aborted (delete-pending) entry must not become readable: its
+  // bytes are garbage and its block is freed on the creator's release.
+  if (!e || e->state != ENTRY_CREATED || (e->flags & 1)) {
+    unlock(h);
+    return -1;
+  }
   e->state = ENTRY_SEALED;
   pthread_cond_broadcast(&h->cond);
   unlock(h);
@@ -491,12 +496,20 @@ int rt_store_release(void* base, const uint8_t* id) {
 }
 
 // Abort a created-but-unsealed object (creator failed mid-write).
+// Marks the entry delete-pending; the block is freed when the LAST
+// reference is released (usually the creator's own, via
+// rt_store_release). Freeing here unconditionally — the seed behavior —
+// raced a creator still writing the payload: the free-list links
+// freelist_insert() writes into the first bytes of the payload, and any
+// recycled allocation's writes, landed under the creator's in-flight
+// memset (TSan-confirmed writer-writer race).
 int rt_store_abort(void* base, const uint8_t* id) {
   Header* h = H(base);
   lock(h);
   Entry* e = find_entry(base, id, false);
   if (!e || e->state != ENTRY_CREATED) { unlock(h); return -1; }
-  delete_entry_locked(base, e);
+  e->flags |= 1;  // delete-pending: seal refuses, last release frees
+  if (e->refcount == 0) delete_entry_locked(base, e);
   unlock(h);
   return 0;
 }

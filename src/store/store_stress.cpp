@@ -10,7 +10,13 @@
 // and drives the cross-thread paths that matter: concurrent creates and
 // seals contending on the arena allocator + pshared mutex, readers
 // pin/release racing the LRU evictor, waiters blocking in get() with a
-// timeout while producers seal.
+// timeout while producers seal (phase 2 — the pthread_cond_timedwait
+// path the original harness never entered: its gets all passed
+// timeout 0), and aborts racing in-flight creator writes while other
+// threads recycle the freed blocks (phase 3 — the abort-vs-writer race
+// rt_store_abort's deferred free closes; the seed abort freed the
+// block under the creator's memset and TSan flagged the recycled
+// allocation's writes against it).
 
 #include "store.cpp"
 
@@ -69,6 +75,82 @@ void worker(void* base, int tid) {
   }
 }
 
+// Phase 2: producers seal while dedicated waiters block in rt_store_get
+// with a real deadline (pthread_cond_timedwait + pshared condvar).
+void waiter(void* base, int tid) {
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    uint8_t id[16];
+    fill_id(id, tid, i);
+    uint64_t got = 0;
+    int64_t off = rt_store_get(base, id, &got, /*timeout_s=*/10.0);
+    if (off < 0) { failures.fetch_add(1); continue; }
+    volatile char c = *((char*)base + off);
+    c = *((char*)base + off + got - 1);
+    (void)c;
+    rt_store_release(base, id);
+    rt_store_delete(base, id);
+  }
+}
+
+void producer(void* base, int tid) {
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    uint8_t id[16];
+    fill_id(id, tid, i);
+    uint64_t size = 256 + (uint64_t)((tid * 37 + i * 11) % 1024);
+    int64_t off = rt_store_create(base, id, size);
+    if (off < 0) { failures.fetch_add(1); continue; }
+    memset((char*)base + off, tid, size);
+    if (rt_store_seal(base, id) != 0) failures.fetch_add(1);
+    rt_store_release(base, id);
+  }
+}
+
+// Phase 3: a foreign thread aborts ids whose creator is mid-write while
+// a recycler churns allocations through the freed blocks. The deferred
+// abort means the creator's bytes stay valid until ITS release; seal
+// after a foreign abort must fail (the entry is delete-pending), and
+// the release then frees the block.
+void abort_creator(void* base, std::atomic<bool>* stop) {
+  uint8_t id[16];
+  memset(id, 0, 16);
+  id[0] = 201;
+  for (int i = 0; i < 1500 && !stop->load(); ++i) {
+    int64_t off = rt_store_create(base, id, 200000);
+    if (off < 0) continue;
+    memset((char*)base + off, 1, 200000);  // may overlap a foreign abort
+    if (rt_store_seal(base, id) == 0) {
+      rt_store_release(base, id);
+      rt_store_delete(base, id);
+    } else {
+      // foreign abort landed first: our release frees the block
+      rt_store_release(base, id);
+    }
+  }
+}
+
+void abort_foreign(void* base, std::atomic<bool>* stop) {
+  uint8_t id[16];
+  memset(id, 0, 16);
+  id[0] = 201;
+  while (!stop->load()) rt_store_abort(base, id);
+}
+
+void abort_recycler(void* base, std::atomic<bool>* stop) {
+  uint8_t id[16];
+  memset(id, 0, 16);
+  id[0] = 202;
+  int i = 0;
+  while (!stop->load()) {
+    id[1] = (uint8_t)(i++);
+    int64_t off = rt_store_create(base, id, 200000);
+    if (off >= 0) {
+      memset((char*)base + off, 2, 200000);
+      rt_store_abort(base, id);
+      rt_store_release(base, id);
+    }
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -89,11 +171,37 @@ int main() {
     ts.emplace_back(worker, base, t);
   }
   for (auto& t : ts) t.join();
-  unlink(path);
   if (failures.load() != 0) {
-    fprintf(stderr, "%d op failures\n", failures.load());
+    fprintf(stderr, "%d op failures (phase 1)\n", failures.load());
     return 1;
   }
+
+  // phase 2: blocking gets (cond_timedwait) racing producers' seals;
+  // waiter/producer pairs share id ranges disjoint from phase 1
+  std::vector<std::thread> wp;
+  for (int t = 0; t < kThreads; ++t) {
+    wp.emplace_back(waiter, base, 100 + t);
+    wp.emplace_back(producer, base, 100 + t);
+  }
+  for (auto& t : wp) t.join();
+  if (failures.load() != 0) {
+    fprintf(stderr, "%d op failures (phase 2)\n", failures.load());
+    return 1;
+  }
+
+  // phase 3: foreign aborts racing an in-flight creator + recycler churn
+  {
+    std::atomic<bool> stop{false};
+    std::thread c(abort_creator, base, &stop);
+    std::thread f(abort_foreign, base, &stop);
+    std::thread r(abort_recycler, base, &stop);
+    c.join();
+    stop.store(true);
+    f.join();
+    r.join();
+  }
+
+  unlink(path);
   printf("store stress ok: %d threads x %d ops\n", kThreads, kOpsPerThread);
   return 0;
 }

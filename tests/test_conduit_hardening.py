@@ -3,9 +3,16 @@
 Builds src/conduit/conduit_stress.cpp — the malformed-frame corpus
 (dribble, interleaved partials, truncation, giant length, zero length)
 plus the stalled-reaper high-water backpressure check — under plain,
-ASAN, and TSAN builds. Precedent: tests/test_native_store_sanitizers.py
-(SURVEY §5.2); the reference leans on gRPC for this bug class, conduit
-owns its framing so it owns the fuzz gate.
+ASAN, UBSAN, and TSAN builds. Precedent:
+tests/test_native_store_sanitizers.py (SURVEY §5.2); the reference
+leans on gRPC for this bug class, conduit owns its framing so it owns
+the fuzz gate.
+
+The TSAN lane (red since it was introduced) is green as of ISSUE 5:
+the reports were fabricated by an uninstrumented
+pthread_cond_clockwait inside condition_variable::wait_for — cd_poll
+now uses a TSan-visible timed wait (DESIGN.md "Enforced invariants &
+the sanitizer matrix").
 """
 
 import shutil

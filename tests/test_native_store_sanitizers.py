@@ -3,7 +3,11 @@
 The reference runs its native core under TSAN/ASAN bazel configs; here
 the single-TU store compiles with each sanitizer and runs a multithreaded
 stress harness (src/store/store_stress.cpp) covering concurrent
-create/seal/get/release/delete against the pshared-mutex arena.
+create/seal/get/release/delete against the pshared-mutex arena, plus
+(ISSUE 5) blocking-get waiters on the pshared condvar and
+foreign-abort/recycle churn — the latter TSan-fails the seed's
+rt_store_abort (it freed the block under a creator's in-flight write;
+the free now defers to the last release, see DESIGN.md).
 """
 
 import shutil

@@ -1,0 +1,245 @@
+"""raylint self-test: fixture corpus (one bad + one good snippet per
+rule), suppression protocol, JSON schema stability, and — the actual
+gate — a repo-wide clean run in tier-1.
+
+The fixtures are written to paths that satisfy each rule's scoping
+(R1 requires a ``_private/`` directory, R3/R4's module prong key off
+wire-module basenames), mirroring how the real tree is laid out.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.raylint import RULES, lint_paths, lint_source
+
+# ---------------------------------------------------------------- corpus
+# rule -> (relative path, bad snippet, good snippet). Each bad snippet
+# must yield >= 1 finding for exactly that rule; each good snippet 0.
+
+CORPUS = {
+    "R1": (
+        "_private/daemon.py",
+        """
+        import time
+        async def handler(conn, data):
+            time.sleep(1.0)
+            return {"ok": True}
+        """,
+        """
+        import asyncio
+        async def handler(conn, data):
+            await asyncio.sleep(1.0)
+            return {"ok": True}
+        """,
+    ),
+    "R2": (
+        "dispatch.py",
+        """
+        async def _handle(self, seqno, method, data, rid=None):
+            return await self.handler(self, method, data)
+        """,
+        """
+        from ray_tpu._private.rpc import run_idempotent
+        async def _handle(self, seqno, method, data, rid=None):
+            kind, payload = await run_idempotent(
+                rid, lambda: self.handler(self, method, data)
+            )
+            return payload
+        """,
+    ),
+    "R3": (
+        "rpc.py",
+        """
+        def send_notify(self, method, data):
+            frame = b"x"
+            self.writer.write(frame)
+        """,
+        """
+        from ray_tpu._private import chaos as _chaos
+        def send_notify(self, method, data):
+            frame = b"x"
+            if _chaos._PLANE is not None and self._chaos_gate(frame):
+                return
+            self.writer.write(frame)
+        """,
+    ),
+    "R4": (
+        "chaos.py",
+        """
+        import random
+        def _decide_prob(self, link, seq):
+            '''Pure function of (seed, link, seq) — the replayable schedule.'''
+            return random.random() < 0.5
+        """,
+        """
+        import hashlib
+        def _decide_prob(self, link, seq):
+            '''Pure function of (seed, link, seq) — the replayable schedule.'''
+            h = hashlib.blake2b(f"{link}|{seq}".encode(), digest_size=8)
+            return int.from_bytes(h.digest(), "big") / 2**64 < 0.5
+        """,
+    ),
+    "R5": (
+        "puller.py",
+        """
+        def read_object(self, oid):
+            view = self.store.get(oid, timeout=0, writable=True)
+            return view
+        """,
+        """
+        def read_object(self, oid):
+            view = self.store.get(oid, timeout=0)
+            return view
+        """,
+    ),
+    "R6": (
+        "loops.py",
+        """
+        async def pump(self):
+            while True:
+                try:
+                    await self.step()
+                except BaseException:
+                    pass
+        """,
+        """
+        async def pump(self):
+            while True:
+                try:
+                    await self.step()
+                except Exception:
+                    pass
+        """,
+    ),
+}
+
+
+def _lint_snippet(rule, snippet):
+    path, _, _ = CORPUS[rule]
+    findings, suppressed = lint_source(
+        textwrap.dedent(snippet), path
+    )
+    return findings, suppressed
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_bad_snippet_fires(rule):
+    findings, _ = _lint_snippet(rule, CORPUS[rule][1])
+    fired = {f.rule for f in findings}
+    assert rule in fired, (
+        f"{rule} did not fire on its bad fixture; got {fired}"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_good_snippet_clean(rule):
+    findings, _ = _lint_snippet(rule, CORPUS[rule][2])
+    assert findings == [], [f.as_dict() for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_suppression_silences(rule):
+    path, bad, _ = CORPUS[rule]
+    findings, _ = _lint_snippet(rule, bad)
+    assert findings, "fixture must fire before testing suppression"
+    lines = textwrap.dedent(bad).splitlines()
+    # same-line disable on every reported line
+    for f in findings:
+        idx = f.line - 1
+        lines[idx] = lines[idx] + f"  # raylint: disable={f.rule} — fixture"
+    suppressed_src = "\n".join(lines)
+    findings2, suppressed = lint_source(suppressed_src, path)
+    assert [f for f in findings2 if f.rule == rule] == []
+    assert suppressed >= 1
+
+
+def test_suppression_by_rule_name_and_def_line():
+    path, bad, _ = CORPUS["R1"]
+    src = textwrap.dedent(bad).replace(
+        "async def handler(conn, data):",
+        "async def handler(conn, data):  "
+        "# raylint: disable=async-blocking — fixture",
+    )
+    findings, suppressed = lint_source(src, path)
+    assert findings == []
+    assert suppressed == 1
+
+
+def test_unrelated_suppression_does_not_silence():
+    path, bad, _ = CORPUS["R1"]
+    src = textwrap.dedent(bad).replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # raylint: disable=R3 — wrong rule",
+    )
+    findings, _ = lint_source(src, path)
+    assert any(f.rule == "R1" for f in findings)
+
+
+def test_json_schema_stable(tmp_path):
+    """The bench gate and future tooling key off this shape."""
+    bad_dir = tmp_path / "_private"
+    bad_dir.mkdir()
+    (bad_dir / "daemon.py").write_text(
+        textwrap.dedent(CORPUS["R1"][1])
+    )
+    report = lint_paths([str(tmp_path)])
+    assert set(report) == {
+        "version", "files_checked", "findings", "suppressed", "counts",
+        "errors",
+    }
+    assert report["version"] == 1
+    assert report["files_checked"] == 1
+    assert report["errors"] == []
+    (finding,) = report["findings"]
+    assert set(finding) == {"file", "line", "col", "rule", "name",
+                            "message"}
+    assert finding["rule"] == "R1"
+    assert finding["name"] == RULES["R1"]
+    assert report["counts"] == {"R1": 1}
+
+
+def test_cli_exit_codes(tmp_path):
+    bad_dir = tmp_path / "_private"
+    bad_dir.mkdir()
+    (bad_dir / "daemon.py").write_text(
+        textwrap.dedent(CORPUS["R1"][1])
+    )
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "--json", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120,
+    )
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    parsed = json.loads(dirty.stdout)
+    assert parsed["counts"].get("R1") == 1
+
+    (bad_dir / "daemon.py").write_text(
+        textwrap.dedent(CORPUS["R1"][2])
+    )
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+def test_parse_error_reported(tmp_path):
+    (tmp_path / "broken.py").write_text("def oops(:\n")
+    report = lint_paths([str(tmp_path)])
+    assert report["errors"] and "parse error" in report["errors"][0]["error"]
+
+
+def test_repo_is_raylint_clean():
+    """THE gate: the whole tree lints clean (deliberate false positives
+    carry inline ``# raylint: disable=<rule>`` annotations)."""
+    report = lint_paths(["ray_tpu", "tests", "tools"], root="/root/repo")
+    assert report["errors"] == [], report["errors"]
+    assert report["findings"] == [], "\n".join(
+        f"{f['file']}:{f['line']}: {f['rule']}({f['name']}): {f['message']}"
+        for f in report["findings"]
+    )
+    # the invariant set is enforced over a real tree, not an empty walk
+    assert report["files_checked"] > 100

@@ -1,0 +1,38 @@
+"""raylint — repo-native static invariant checker for the async control
+plane (stdlib ``ast`` only, no dependencies).
+
+PRs 1–2 introduced invariants that nothing enforced mechanically:
+control-plane mutations ride ``rpc.run_idempotent`` (effectively-once),
+every wire send path passes the chaos hook, chaos-replayed code consumes
+no unseeded time/randomness, writable shm views never escape
+``serialization._pinned_buffer``, and event-loop tasks never swallow
+cancellation.  raylint walks the AST and enforces them as tier-1 tests
+(``tests/test_raylint.py``) and a bench-gate metric (``bench.py``).
+
+Usage::
+
+    python -m tools.raylint ray_tpu tests          # text report, rc 1 on findings
+    python -m tools.raylint --json ray_tpu tests   # machine-readable
+
+Suppress a deliberate finding on its line (or the line above, or the
+enclosing ``def`` line) with a reason::
+
+    fut.result()  # raylint: disable=R1 — future is done() — non-blocking
+
+Rules (DESIGN.md "Enforced invariants" maps each to the PR that
+introduced the invariant):
+
+R1 async-blocking          blocking calls inside ``async def`` in _private/
+R2 handler-no-dedup        handler dispatch outside rpc.run_idempotent
+R3 send-bypasses-chaos     wire sends in rpc.py/conduit_rpc.py off the chaos hook
+R4 unseeded-randomness     unseeded random/time in replay-deterministic code
+R5 writable-view-escape    Store.get(writable=True) outside the pin path
+R6 swallowed-cancellation  bare except / swallowed CancelledError in async code
+"""
+
+from tools.raylint.core import (  # noqa: F401
+    RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from tools.raylint.core import main
+
+sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,233 @@
+"""raylint engine: file walking, suppressions, reporting.
+
+The rule checkers live in :mod:`tools.raylint.rules`; this module owns
+everything rule-independent — parsing, the ``# raylint: disable=<rule>``
+suppression protocol, and the text/JSON reports.
+
+Suppression protocol: a finding is silenced when a ``# raylint:
+disable=R3`` (rule id, rule name, or ``all``; comma-separated for
+several) comment sits on the finding's line, the line directly above
+it, or the ``def`` line of the enclosing function. Suppressions are
+counted and surfaced in the JSON report so a creeping pile of disables
+is itself visible.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: rule id -> short name. Stable: tests and bench assert on these.
+RULES = {
+    "R1": "async-blocking",
+    "R2": "handler-no-dedup",
+    "R3": "send-bypasses-chaos",
+    "R4": "unseeded-randomness",
+    "R5": "writable-view-escape",
+    "R6": "swallowed-cancellation",
+}
+_NAME_TO_ID = {name: rid for rid, name in RULES.items()}
+
+_DISABLE_RE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("file", "line", "col", "rule", "message", "func_line")
+
+    def __init__(self, file: str, line: int, col: int, rule: str,
+                 message: str, func_line: Optional[int] = None):
+        self.file = file
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+        # def-line of the enclosing function (suppression anchor), if any
+        self.func_line = func_line
+
+    @property
+    def rule_name(self) -> str:
+        return RULES.get(self.rule, self.rule)
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "name": self.rule_name,
+            "message": self.message,
+        }
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<Finding {self.file}:{self.line} {self.rule}>"
+
+
+def _parse_suppressions(source: str) -> Dict[int, set]:
+    """Map 1-based line number -> set of suppressed rule ids ('*' = all)."""
+    out: Dict[int, set] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        rules = set()
+        for tok in m.group(1).split(","):
+            tok = tok.strip().split()[0] if tok.strip() else ""
+            if not tok:
+                continue
+            if tok.lower() == "all":
+                rules.add("*")
+            elif tok.upper() in RULES:
+                rules.add(tok.upper())
+            elif tok.lower() in _NAME_TO_ID:
+                rules.add(_NAME_TO_ID[tok.lower()])
+        if rules:
+            out[i] = rules
+    return out
+
+
+def _suppressed(finding: Finding, supp: Dict[int, set]) -> bool:
+    anchors = [finding.line, finding.line - 1]
+    if finding.func_line is not None:
+        anchors.append(finding.func_line)
+    for ln in anchors:
+        rules = supp.get(ln)
+        if rules and ("*" in rules or finding.rule in rules):
+            return True
+    return False
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Iterable[str]] = None
+                ) -> Tuple[List[Finding], int]:
+    """Lint one file's source. Returns (visible findings, suppressed
+    count). ``path`` drives rule scoping (``_private/`` membership,
+    basename) — pass a repo-relative path."""
+    from tools.raylint import rules as rule_mod
+
+    tree = ast.parse(source, filename=path)
+    enabled = set(rules) if rules else set(RULES)
+    raw = rule_mod.check_tree(tree, path, enabled)
+    supp = _parse_suppressions(source)
+    visible = [f for f in raw if not _suppressed(f, supp)]
+    return visible, len(raw) - len(visible)
+
+
+_SKIP_DIRS = {"__pycache__", "_native", ".git", ".pytest_cache", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str], root: str = ".") -> List[str]:
+    out = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in _SKIP_DIRS
+            )
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return out
+
+
+def lint_paths(paths: Iterable[str], root: str = ".",
+               rules: Optional[Iterable[str]] = None) -> dict:
+    """Lint every .py file under ``paths``. Returns the report dict used
+    by both the CLI and the bench gate:
+
+    ``{"version": 1, "files_checked": n, "findings": [...],
+       "suppressed": n, "counts": {rule_id: n}, "errors": [...]}``
+    """
+    findings: List[Finding] = []
+    errors: List[dict] = []
+    suppressed = 0
+    files = iter_py_files(paths, root=root)
+    for full in files:
+        rel = os.path.relpath(full, root)
+        try:
+            with open(full, "r", encoding="utf-8", errors="replace") as f:
+                source = f.read()
+            vis, supp = lint_source(source, rel, rules=rules)
+        except SyntaxError as e:
+            errors.append({"file": rel, "line": e.lineno or 0,
+                           "error": f"parse error: {e.msg}"})
+            continue
+        findings.extend(vis)
+        suppressed += supp
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "version": 1,
+        "files_checked": len(files),
+        "findings": [f.as_dict() for f in findings],
+        "suppressed": suppressed,
+        "counts": counts,
+        "errors": errors,
+    }
+
+
+def format_text(report: dict) -> str:
+    lines = []
+    for f in report["findings"]:
+        lines.append(
+            f"{f['file']}:{f['line']}:{f['col']}: "
+            f"{f['rule']}({f['name']}): {f['message']}"
+        )
+    for e in report["errors"]:
+        lines.append(f"{e['file']}:{e['line']}: E0(parse): {e['error']}")
+    n = len(report["findings"])
+    lines.append(
+        f"raylint: {n} finding{'s' if n != 1 else ''} "
+        f"({report['suppressed']} suppressed) "
+        f"in {report['files_checked']} files"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    as_json = False
+    rules: Optional[List[str]] = None
+    paths: List[str] = []
+    it = iter(argv)
+    for a in it:
+        if a == "--json":
+            as_json = True
+        elif a == "--rules":
+            try:
+                rules = [
+                    r.strip().upper() for r in next(it).split(",") if r.strip()
+                ]
+            except StopIteration:
+                print("raylint: --rules needs an argument", flush=True)
+                return 2
+            unknown = [r for r in rules if r not in RULES]
+            if unknown:
+                print(f"raylint: unknown rules {unknown} "
+                      f"(have {sorted(RULES)})", flush=True)
+                return 2
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            print(f"rules: {json.dumps(RULES, indent=2)}")
+            return 0
+        else:
+            paths.append(a)
+    if not paths:
+        print("usage: python -m tools.raylint [--json] [--rules R1,R2] "
+              "<path> [<path> ...]", flush=True)
+        return 2
+    report = lint_paths(paths, rules=rules)
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_text(report))
+    if report["errors"]:
+        return 2
+    return 1 if report["findings"] else 0

@@ -1,0 +1,399 @@
+"""raylint rule checkers R1–R6.
+
+Every rule is grounded in an invariant this codebase already relies on
+(see DESIGN.md "Enforced invariants" for the PR that introduced each):
+
+R1 async-blocking          The whole control plane is ~90 ``async def``
+                           handlers on one event loop per process; one
+                           blocking call stalls heartbeats, leases and
+                           pulls for everyone.
+R2 handler-no-dedup        Effectively-once mutations depend on every
+                           dispatch path routing through
+                           ``rpc.run_idempotent`` — a direct
+                           ``self.handler(...)`` call reintroduces
+                           double-apply under client replay.
+R3 send-bypasses-chaos     Fault schedules only replay if every wire
+                           send in rpc.py / conduit_rpc.py consults the
+                           chaos plane; a bypassing send path silently
+                           stops injecting faults.
+R4 unseeded-randomness     Replay-deterministic code (schedule
+                           enumeration, chaos-replayed control paths)
+                           must draw from seeded RNGs
+                           (``chaos.replay_rng``) and take time as a
+                           parameter, or replays diverge.
+R5 writable-view-escape    ``Store.get(writable=True)`` exists solely to
+                           feed ``serialization._pinned_buffer``'s
+                           pre-3.12 pin carrier; anywhere else it hands
+                           out a mutable view of a sealed (immutable)
+                           object.
+R6 swallowed-cancellation  ``asyncio.CancelledError`` must propagate out
+                           of event-loop tasks or daemon loops never
+                           shut down (bare ``except:`` swallows it).
+
+Scoping: R1 applies to files under a ``_private/`` directory; R3 and the
+module prong of R4 apply to the wire/control modules by basename; the
+docstring prong of R4 applies anywhere a function's docstring declares
+determinism ("deterministic", "replayable", "byte-identical",
+"pure function", "chaos-replay" — the repo convention these checkers
+enforce); R2/R5/R6 apply everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from tools.raylint.core import Finding
+
+# ---------------------------------------------------------------- helpers
+
+#: R1: calls that block the event loop outright.
+_R1_BLOCKING = {
+    "time.sleep",
+    "os.system",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+}
+#: R1: blocking file ops (use asyncio.to_thread / run_in_executor).
+_R1_FILE = {"open", "os.listdir", "os.stat", "os.path.getsize"}
+
+#: R3 scope + R4 module-prong scope (wire/control modules by basename).
+_R3_FILES = {"rpc.py", "conduit_rpc.py"}
+_R4_FILES = {"chaos.py", "rpc.py", "conduit_rpc.py", "raylet.py", "gcs.py"}
+
+#: R4: draws on the process-global (OS-seeded) random module.
+_R4_DRAWS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "betavariate", "expovariate", "gauss",
+    "getrandbits", "normalvariate", "triangular",
+}
+_R4_TIME = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "os.urandom", "uuid.uuid4",
+}
+_R4_DOC_MARKERS = (
+    "deterministic", "replayable", "byte-identical", "pure function",
+    "chaos-replay",
+)
+
+
+def _dotted(node: ast.expr) -> str:
+    """Best-effort dotted name of a call target ('self.writer.write')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> real module for plain imports (``import random as
+    _random`` -> {'_random': 'random'})."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+    return out
+
+
+def _resolve(name: str, aliases: Dict[str, str]) -> str:
+    """Rewrite the leading segment of a dotted name through the import
+    alias map ('_random.random' -> 'random.random')."""
+    head, _, rest = name.partition(".")
+    real = aliases.get(head)
+    if real is None:
+        return name
+    return real + ("." + rest if rest else "")
+
+
+def _walk_skip_nested(fn: ast.AST):
+    """Yield nodes of a function body without descending into nested
+    function definitions (their bodies run in their own context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _subtree_calls(node: ast.AST) -> Set[int]:
+    return {id(n) for n in ast.walk(node) if isinstance(n, ast.Call)}
+
+
+# ---------------------------------------------------------------- rules
+
+
+def _check_r1(fn: ast.AsyncFunctionDef, path: str, aliases,
+              findings: List[Finding]):
+    awaited: Set[int] = set()
+    for node in _walk_skip_nested(fn):
+        if isinstance(node, ast.Await):
+            awaited |= _subtree_calls(node)
+    for node in _walk_skip_nested(fn):
+        if isinstance(node, ast.Call):
+            name = _resolve(_dotted(node.func), aliases)
+            if name in _R1_BLOCKING:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R1",
+                    f"blocking call {name}() inside async def "
+                    f"{fn.name} (stalls the event loop)",
+                    func_line=fn.lineno))
+            elif name in _R1_FILE:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R1",
+                    f"blocking file op {name}() inside async def "
+                    f"{fn.name} (use asyncio.to_thread / "
+                    f"run_in_executor)", func_line=fn.lineno))
+            elif (name.endswith(".result") and "?" not in name
+                  and id(node) not in awaited):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R1",
+                    f"{name}() inside async def {fn.name}: blocks the "
+                    f"loop if the future is not done (await it, or "
+                    f"guard with .done())", func_line=fn.lineno))
+            elif (name.endswith((".acquire", ".wait"))
+                  and "?" not in name and id(node) not in awaited):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R1",
+                    f"un-awaited {name}() inside async def {fn.name}: "
+                    f"a threading primitive here blocks the loop "
+                    f"(asyncio primitives must be awaited)",
+                    func_line=fn.lineno))
+        elif isinstance(node, ast.With):
+            ctx = " ".join(
+                _dotted(item.context_expr) for item in node.items
+            )
+            if "lock" in ctx.lower() and any(
+                isinstance(x, ast.Await)
+                for stmt in node.body for x in ast.walk(stmt)
+            ):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R1",
+                    f"sync `with {ctx}:` spans an await in async def "
+                    f"{fn.name}: a threading.Lock here is held across "
+                    f"the suspension (every other task blocks on it)",
+                    func_line=fn.lineno))
+
+
+def _check_r2(tree: ast.AST, path: str, func_of,
+              findings: List[Finding]):
+    wrapped: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func).endswith(
+            "run_idempotent"
+        ):
+            wrapped |= _subtree_calls(node)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "handler"
+                and id(node) not in wrapped):
+            fn = func_of(node)
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "R2",
+                "handler dispatched outside rpc.run_idempotent: a "
+                "replayed request double-applies its mutation (wrap as "
+                "run_idempotent(rid, lambda: ...handler(...)))",
+                func_line=fn.lineno if fn else None))
+
+
+def _fn_touches_chaos(fn: ast.AST) -> bool:
+    if "chaos" in getattr(fn, "name", "").lower():
+        return True
+    for node in _walk_skip_nested(fn):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident and "chaos" in ident.lower():
+            return True
+    return False
+
+
+def _check_r3(tree: ast.AST, path: str, func_of,
+              findings: List[Finding]):
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # compliant if the function — or any enclosing function (a
+        # closure defined inside _chaos_gate IS the chaos plane's write
+        # path) — consults the chaos plane
+        has_chaos, cur = False, fn
+        while cur is not None and not has_chaos:
+            has_chaos = _fn_touches_chaos(cur)
+            cur = func_of(cur)
+        if has_chaos:
+            continue
+        for node in _walk_skip_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if (name.endswith("writer.write")
+                    or name.endswith("engine.send")
+                    or name.endswith("engine.send_iov")
+                    or name == "cd_send"):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R3",
+                    f"wire send {name}() in {fn.name} bypasses the "
+                    f"chaos hook: fault schedules silently stop "
+                    f"replaying on this path (route through "
+                    f"_chaos_gate / the plane decide())",
+                    func_line=fn.lineno))
+
+
+def _check_r4(tree: ast.AST, path: str, aliases,
+              findings: List[Finding]):
+    base = os.path.basename(path)
+    module_scope = base in _R4_FILES
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        doc = (ast.get_docstring(fn) or "").lower()
+        marked = any(m in doc for m in _R4_DOC_MARKERS)
+        if not (marked or module_scope):
+            continue
+        for node in _walk_skip_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _resolve(_dotted(node.func), aliases)
+            head, _, tail = name.partition(".")
+            if head == "random" and tail in _R4_DRAWS:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R4",
+                    f"{name}() draws from the OS-seeded global RNG in "
+                    + ("replay-deterministic " if marked else
+                       "chaos-replayed ")
+                    + f"code ({fn.name}): use chaos.replay_rng(tag)",
+                    func_line=fn.lineno))
+            elif marked and name in _R4_TIME:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "R4",
+                    f"{name}() in replay-deterministic code "
+                    f"({fn.name}): take the timestamp/entropy as a "
+                    f"parameter instead", func_line=fn.lineno))
+
+
+def _check_r5(tree: ast.AST, path: str, func_of,
+              findings: List[Finding]):
+    base = os.path.basename(path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        writable = any(
+            kw.arg == "writable"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords
+        )
+        if not writable or not _dotted(node.func).endswith(".get"):
+            continue
+        fn = func_of(node)
+        if base == "serialization.py" and fn is not None and (
+            fn.name == "_pinned_buffer"
+        ):
+            continue
+        findings.append(Finding(
+            path, node.lineno, node.col_offset, "R5",
+            "Store.get(writable=True) outside "
+            "serialization._pinned_buffer: hands out a mutable view "
+            "of a sealed object (consumers must only ever see "
+            "read-only views)",
+            func_line=fn.lineno if fn else None))
+
+
+def _check_r6(fn: ast.AsyncFunctionDef, path: str,
+              findings: List[Finding]):
+    for node in _walk_skip_nested(fn):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught: List[str] = []
+        def collect(t):
+            if t is None:
+                caught.append("<bare>")
+            elif isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    collect(el)
+            else:
+                caught.append(_dotted(t))
+        collect(node.type)
+        bad = [c for c in caught
+               if c in ("<bare>", "BaseException")
+               or c.endswith("CancelledError")]
+        if not bad:
+            continue
+        reraises = any(
+            isinstance(x, ast.Raise)
+            for stmt in node.body for x in ast.walk(stmt)
+        )
+        if reraises:
+            continue
+        what = ", ".join(bad)
+        findings.append(Finding(
+            path, node.lineno, node.col_offset, "R6",
+            f"except {what} in async def {fn.name} swallows "
+            f"cancellation (no re-raise): the task never exits on "
+            f"shutdown — re-raise, or narrow to Exception",
+            func_line=fn.lineno))
+
+
+# ---------------------------------------------------------------- driver
+
+
+def check_tree(tree: ast.AST, path: str,
+               enabled: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    posix = path.replace(os.sep, "/")
+    in_private = "_private" in posix.split("/")
+    base = os.path.basename(path)
+    aliases = _import_aliases(tree)
+
+    # enclosing-function lookup (suppression anchor for def-line disables)
+    parent_fn: Dict[int, ast.AST] = {}
+
+    def index(node, fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parent_fn[id(child)] = fn
+                index(child, child)
+            else:
+                parent_fn[id(child)] = fn
+                index(child, fn)
+
+    index(tree, None)
+
+    def func_of(node) -> Optional[ast.AST]:
+        return parent_fn.get(id(node))
+
+    if "R2" in enabled:
+        _check_r2(tree, path, func_of, findings)
+    if "R3" in enabled and base in _R3_FILES:
+        _check_r3(tree, path, func_of, findings)
+    if "R4" in enabled:
+        _check_r4(tree, path, aliases, findings)
+    if "R5" in enabled:
+        _check_r5(tree, path, func_of, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            if "R1" in enabled and in_private:
+                _check_r1(node, path, aliases, findings)
+            if "R6" in enabled:
+                _check_r6(node, path, findings)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
