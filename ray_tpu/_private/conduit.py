@@ -77,7 +77,24 @@ def load():
     global _lib
     with _lib_lock:
         if _lib is None:
-            lib = ctypes.CDLL(_ensure_built())
+            path = _ensure_built()
+            lib = ctypes.CDLL(path)
+            # Hot NON-BLOCKING entry points route through PyDLL (GIL
+            # held across the call): a ctypes CDLL call releases the
+            # GIL and then must RE-ACQUIRE it, which under a busy
+            # process stalls up to the switch interval (~5ms) — at
+            # task-plane rates the per-send reacquisition wait dwarfed
+            # the native work (mutex + memcpy + eventfd, single-digit
+            # µs). Safe because these functions never take the GIL
+            # themselves (no Python callbacks) and their engine-mutex
+            # critical sections are microsecond-bounded — no lock
+            # inversion against the GIL is possible. Genuinely blocking
+            # calls (cd_poll, cd_connect, cd_sink_unregister,
+            # cd_engine_stop) stay on the GIL-releasing CDLL.
+            pylib = ctypes.PyDLL(path)
+            for name in ("cd_send", "cd_push_batch", "cd_send_iov",
+                         "cd_free", "cd_ev_bytes", "cd_sink_register"):
+                setattr(lib, name, getattr(pylib, name))
             lib.cd_engine_new.restype = ctypes.c_void_p
             lib.cd_engine_stop.argtypes = [ctypes.c_void_p]
             lib.cd_listen.argtypes = [
@@ -92,6 +109,11 @@ def load():
                 ctypes.c_char_p, ctypes.c_uint32,
             ]
             lib.cd_send.restype = ctypes.c_int64
+            lib.cd_push_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_uint64,
+            ]
+            lib.cd_push_batch.restype = ctypes.c_int64
             lib.cd_send_iov.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_char_p, ctypes.c_uint32,
@@ -241,6 +263,17 @@ class Engine:
         """Queue one frame. Returns bytes queued on the conn (backpressure
         signal), raises ConnectionError if the conn is gone."""
         n = self.lib.cd_send(self.h, conn_id, payload, len(payload))
+        if n < 0:
+            raise ConnectionError(f"conduit conn {conn_id} closed")
+        return n
+
+    def send_batch(self, conn_id: int, framed: bytes) -> int:
+        """Queue a batch of PRE-FRAMED frames ([u32 BE len][body]
+        repeated) in one native call: one lock/memcpy/wake — and
+        typically one writev — for the whole burst (the task-plane push
+        hot path). The wire is byte-identical to per-frame send()s, so
+        any peer (conduit or asyncio) parses it unchanged."""
+        n = self.lib.cd_push_batch(self.h, conn_id, framed, len(framed))
         if n < 0:
             raise ConnectionError(f"conduit conn {conn_id} closed")
         return n

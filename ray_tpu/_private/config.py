@@ -141,6 +141,17 @@ _d("actor_pipeline_depth", int, 256)
 # serve worker task endpoints through the native conduit wire engine
 # (src/conduit/conduit.cpp) when it builds; asyncio transport otherwise
 _d("native_wire", bool, True)
+# owners open their worker-push connections through the conduit engine
+# too (corked bursts flush as ONE native cd_push_batch; frame parsing
+# and socket IO leave the asyncio loop). Same wire format either way —
+# disable to force the asyncio client transport (interop testing).
+_d("native_push_conns", bool, True)
+# task returns at most this many packed bytes ride INLINE in the
+# task_done completion frame ("v" element) — the owner materializes the
+# ObjectRef straight from the frame, no store put/pin/get round trip.
+# Bigger returns are store-backed ("p" element). 0 disables inlining
+# (every return store-backed — the legacy/interop fallback shape).
+_d("task_inline_return_bytes", int, 64 * 1024)
 # conduit reap-queue high-water mark: past this many MB of unreaped
 # frames the engine stops reading sockets (bounded memory under a
 # stalled reaper; backpressure propagates to senders' queues)

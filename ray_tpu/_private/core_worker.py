@@ -118,24 +118,37 @@ class _StorePin:
 
 
 class _PendingObject:
-    __slots__ = ("event", "kind", "value", "locations", "_listeners", "_lock")
+    """One pending-or-resolved in-process object.
+
+    SLIM ON PURPOSE: a 1M-queued-task envelope holds one of these per
+    outstanding return, so there is no per-entry Event/Condition —
+    ``ready`` is a plain flag (``resolve`` writes kind/value/locations
+    BEFORE it, and the GIL orders those stores for readers that check
+    ``ready`` first) and blocking waiters register listener callbacks
+    under one class-wide lock instead of parking on per-entry
+    primitives."""
+
+    __slots__ = ("ready", "kind", "value", "locations", "_listeners")
+
+    _lock = threading.Lock()  # listener registration vs resolve, all entries
 
     def __init__(self):
-        self.event = threading.Event()
-        self.kind = None  # "value" | "plasma" | "error"
+        self.ready = False
+        # "value" | "packed" (lazily-decoded wire bytes) | "plasma"
+        # | "error"
+        self.kind = None
         self.value = None
-        self.locations = []
-        self._listeners = []
-        self._lock = threading.Lock()
+        self.locations = ()
+        self._listeners = None
 
     def resolve(self, kind, value=None, locations=()):
         self.kind = kind
         self.value = value
         self.locations = list(locations)
-        self.event.set()
         with self._lock:
-            cbs, self._listeners = self._listeners, []
-        for cb in cbs:
+            self.ready = True
+            cbs, self._listeners = self._listeners, None
+        for cb in cbs or ():
             try:
                 cb()
             except Exception:
@@ -145,7 +158,9 @@ class _PendingObject:
         """cb fires (from the resolving thread) when the entry resolves; fires
         immediately if already resolved. Used for event-driven get/wait."""
         with self._lock:
-            if not self.event.is_set():
+            if not self.ready:
+                if self._listeners is None:
+                    self._listeners = []
                 self._listeners.append(cb)
                 return
         cb()
@@ -167,6 +182,12 @@ class MemoryStore:
 
     def put_value(self, oid: ObjectID, value):
         self.entry(oid).resolve("value", value)
+
+    def put_packed(self, oid: ObjectID, packed):
+        """Resolve with the UNDECODED wire bytes of an inlined task
+        return: consumers deserialize on THEIR thread at first get
+        (_materialize_entry) — the IO loop never pays the unpack."""
+        self.entry(oid).resolve("packed", bytes(packed))
 
     def put_error(self, oid: ObjectID, error: BaseException):
         self.entry(oid).resolve("error", error)
@@ -450,6 +471,7 @@ class CoreWorker:
         # cross-thread submit batching (one loop wakeup per burst)
         self._spawn_lock = threading.Lock()
         self._spawn_batch: List = []
+        self._submit_specs: List = []  # plain-task specs (batch drain)
         self._spawn_scheduled = False
 
         # executor state (worker mode)
@@ -465,6 +487,12 @@ class CoreWorker:
         self._actor_aio_sem = None
         self._current_task_name = ""
         self._shutdown = threading.Event()
+        # task-return inlining counters (executor side: returns encoded
+        # into completion frames; owner side: ObjectRefs materialized
+        # from them) — surfaced via rpc_task_stats into node_stats and
+        # the perf bench's micro detail
+        self.task_inline_hits = 0
+        self.task_inline_bytes = 0
         # task-event buffer (batched to the GCS task manager)
         self._task_events: List[Dict] = []
         self._task_event_lock = threading.Lock()
@@ -630,28 +658,34 @@ class CoreWorker:
         # bookkeeping. The cluster-wide free RPC below would otherwise run
         # once per actor call on the hot path.
         e = self.memory_store.get(oid)
-        inline_only = (
-            e is not None and e.event.is_set() and e.kind == "value"
-        )
         self.memory_store.pop(oid)
         self._owned.discard(oid)
         self._lineage.pop(oid, None)
         self._deferred_free.discard(oid)
         self._contained.pop(oid, None)  # drop containment pins (inner refs)
-        if inline_only:
-            try:
-                if not self.store.contains(oid):
-                    return
-            except Exception:
-                return
+        # kind is re-read AFTER the pop: a concurrent
+        # _resolve_dependencies promotion flips it to "plasma" only
+        # once the store copy exists, so an inline verdict here plus
+        # the promotion's own freed-entry check (see
+        # _resolve_dependencies) covers every interleaving
+        if e is not None and e.ready and e.kind in ("value", "packed"):
+            # value/packed entries were never written to the local store,
+            # so the contains/delete probes and the cluster-wide free RPC
+            # below would be pure per-task overhead on the hot path
+            return
+        self._free_store_copy(oid)
+
+    def _free_store_copy(self, oid: ObjectID):
+        """Delete the local store copy and fan out the cluster-wide
+        free (one RPC: the GCS forwards to every node holding a copy —
+        in-store or spilled — and drops the location entry). Shared by
+        _free_object and the promotion-orphan path; idempotent."""
         try:
             if self.store.contains(oid):
                 self.store.delete(oid)
         except Exception:
             pass
         try:
-            # Single RPC: the GCS fans the free out to every node holding a
-            # copy (in-store or spilled) and drops the location entry.
             self.io.submit(
                 self.gcs.conn.call_async("free_object", oid.binary(),
                                          timeout=10)
@@ -819,7 +853,7 @@ class CoreWorker:
             """Try one ref; returns True if resolved into results[i]."""
             nonlocal unresolved
             e = self.memory_store.get(ref.id)
-            if e is not None and not e.event.is_set():
+            if e is not None and not e.ready:
                 # add_listener fires the callback immediately if the entry
                 # resolved between the get() above and here
                 e.add_listener(lambda i=i: (ready.append(i), wake.set()))
@@ -864,10 +898,31 @@ class CoreWorker:
             out.append(v)
         return out
 
+    @staticmethod
+    def _materialize_entry(e: _PendingObject):
+        """Decode a lazily-stored packed return in place (consumer
+        thread — the IO loop stores the wire bytes without paying the
+        unpack). Racing materializers may both deserialize (harmless: a
+        loser's value is dropped) but exactly one commits, and the
+        packed bytes are snapshotted under the lock so a racer can never
+        unpack an already-decoded value."""
+        with _PendingObject._lock:
+            if e.kind != "packed":
+                return
+            packed = e.value
+        value = serialization.unpack(packed)
+        err = isinstance(value, exc.ErrorObject)
+        with _PendingObject._lock:
+            if e.kind == "packed":
+                e.value = value.error if err else value
+                e.kind = "error" if err else "value"
+
     def _try_get_one(self, ref: ObjectRef, requested_pull, wake=None,
                      listening=None):
         e = self.memory_store.get(ref.id)
-        if e is not None and e.event.is_set():
+        if e is not None and e.ready:
+            if e.kind == "packed":
+                self._materialize_entry(e)
             if e.kind == "value":
                 return e.value
             if e.kind == "error":
@@ -1014,11 +1069,15 @@ class CoreWorker:
         """Serve an owned object's value to a borrower."""
         oid = ObjectID(oid_bytes)
         e = self.memory_store.get(oid)
-        if e is not None and e.event.is_set():
-            if e.kind == "value":
-                return serialization.pack(e.value)
-            if e.kind == "error":
-                return serialization.pack(exc.ErrorObject(e.value))
+        if e is not None and e.ready:
+            with _PendingObject._lock:
+                kind, value = e.kind, e.value
+            if kind == "packed":
+                return value  # already the wire form: no decode/re-pack
+            if kind == "value":
+                return serialization.pack(value)
+            if kind == "error":
+                return serialization.pack(exc.ErrorObject(value))
         view = self.store.get(oid, timeout=0)
         if view is not None:
             try:
@@ -1044,7 +1103,7 @@ class CoreWorker:
             still = []
             for ref in pending:
                 e = self.memory_store.get(ref.id)
-                resolved = e is not None and e.event.is_set()
+                resolved = e is not None and e.ready
                 local = self.store.contains(ref.id)
                 if resolved and e.kind == "plasma" and not local:
                     # Object exists remotely: that's "ready" per reference
@@ -1216,7 +1275,7 @@ class CoreWorker:
             self._gen_streams[spec.task_id] = stream
             refs = [StreamingObjectRefGenerator(stream, refs[0])]
         self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
-        self._io_spawn(self._submit_async(spec))
+        self._io_spawn_submit(spec)
         return refs
 
     def _io_spawn(self, coro):
@@ -1232,6 +1291,20 @@ class CoreWorker:
             self._spawn_scheduled = True
         self.io.loop.call_soon_threadsafe(self._drain_spawn)
 
+    def _io_spawn_submit(self, spec: TaskSpec):
+        """Queue a PLAIN-task spec for loop-side submission. Batch-aware
+        hot path: the drain enqueues ref-free specs STRAIGHT into their
+        lease queues as plain function work — no per-task asyncio task,
+        no coroutine switch — and kicks each touched lease key once per
+        burst. Specs with ObjectRef args still get a coroutine (their
+        dependency resolution awaits entry resolution)."""
+        with self._spawn_lock:
+            self._submit_specs.append(spec)
+            if self._spawn_scheduled:
+                return
+            self._spawn_scheduled = True
+        self.io.loop.call_soon_threadsafe(self._drain_spawn)
+
     @staticmethod
     def _swallow_task_exc(t):
         if not t.cancelled() and t.exception() is not None:
@@ -1242,10 +1315,35 @@ class CoreWorker:
     def _drain_spawn(self):
         with self._spawn_lock:
             batch, self._spawn_batch = self._spawn_batch, []
+            specs, self._submit_specs = self._submit_specs, []
             self._spawn_scheduled = False
         loop = asyncio.get_running_loop()
         for coro in batch:
             loop.create_task(coro).add_done_callback(self._swallow_task_exc)
+        if specs:
+            self._submit_specs_now(specs, loop)
+
+    def _submit_specs_now(self, specs: List[TaskSpec], loop):
+        """Loop-side burst submission (see _io_spawn_submit)."""
+        touched: Dict[Tuple, _LeaseState] = {}
+        for spec in specs:
+            if any(a[0] == "r" for a in spec.args):
+                loop.create_task(
+                    self._submit_async(spec)
+                ).add_done_callback(self._swallow_task_exc)
+                continue
+            info = self._pending_tasks.get(spec.task_id)
+            if info is not None:
+                info["state"] = "queued"
+            key = self._lease_key(spec)
+            st = self._lease_states.get(key)
+            if st is None:
+                st = self._lease_states[key] = _LeaseState()
+                st.strategy = spec.scheduling_strategy
+            st.queue.append(spec)
+            touched[key] = st
+        for key, st in touched.items():
+            self._maybe_request_lease(key, st)
 
     # ================= task events (observability) =================
     # Parity: reference TaskEventBuffer (task_event_buffer.h:199) batching
@@ -1342,7 +1440,7 @@ class CoreWorker:
 
     async def _wait_entry(self, e: _PendingObject):
         """Await entry resolution on the IO loop without polling."""
-        if e.event.is_set():
+        if e.ready:
             return
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
@@ -1365,6 +1463,23 @@ class CoreWorker:
             if e is None:
                 continue  # borrowed / plasma ref: executor will fetch
             await self._wait_entry(e)
+            if e.kind == "packed":
+                # lazily-stored inlined return used as an arg: the
+                # entry already IS the wire form — decode once (cached;
+                # reveals a pathological error value) but ship the
+                # ORIGINAL bytes, skipping the re-pack a chained
+                # small-task pipeline would otherwise pay per hop
+                with _PendingObject._lock:
+                    packed = e.value if e.kind == "packed" else None
+                self._materialize_entry(e)
+                if (
+                    packed is not None
+                    and e.kind == "value"
+                    and len(packed) <= GLOBAL_CONFIG.inline_object_max_bytes
+                ):
+                    spec.args[i] = ["v", packed]
+                    continue
+                # oversized or error: fall through to the paths below
             if e.kind == "value":
                 packed = serialization.pack(e.value)
                 if len(packed) <= GLOBAL_CONFIG.inline_object_max_bytes:
@@ -1378,6 +1493,14 @@ class CoreWorker:
                         "add_object_location", [oid.binary(), self.node_id]
                     )
                     e.kind = "plasma"
+                    if self.memory_store.get(oid) is None:
+                        # the last ref was dropped while the promotion
+                        # was in flight: _free_object took the inline
+                        # fast path (no store copy existed when it ran),
+                        # so the just-created store copy + location
+                        # entry are ours to clean up (idempotent vs a
+                        # racing free)
+                        self._free_store_copy(oid)
             elif e.kind == "error":
                 raise e.value
 
@@ -1471,7 +1594,7 @@ class CoreWorker:
                 continue
             oid = ObjectID(bytes(a[1]))
             e = self.memory_store.get(oid)
-            if e is not None and e.event.is_set() and e.kind != "plasma":
+            if e is not None and e.ready and e.kind != "plasma":
                 continue
             out.append([bytes(a[1]), a[2]])
         return out
@@ -1609,11 +1732,12 @@ class CoreWorker:
             kind, payload = reply["returns"][0]
             oid = spec.return_ids()[0]
             if kind == "v":
-                value = serialization.unpack(payload)
-                if isinstance(value, exc.ErrorObject):
-                    self.memory_store.put_error(oid, value.error)
-                else:
-                    self.memory_store.put_value(oid, value)
+                # materialize the ObjectRef straight from the completion
+                # frame: no store round trip, and no unpack on the IO
+                # loop — consumers decode on their own thread
+                self.task_inline_hits += 1
+                self.task_inline_bytes += len(payload)
+                self.memory_store.put_packed(oid, payload)
             else:
                 self.memory_store.put_plasma(oid, [worker_addr[2]])
             self._cancelled.discard(spec.task_id)
@@ -1726,7 +1850,14 @@ class CoreWorker:
         """Single-flight connection cache: with pipelined submission many
         coroutines race here for a cold address — they must share ONE
         socket (ordering of actor pushes rides connection FIFO) instead of
-        each opening a duplicate."""
+        each opening a duplicate.
+
+        With the native wire enabled these conns ride the conduit engine
+        (``native_push_conns``): corked push bursts flush as one
+        ``cd_push_batch``, and frame parsing/socket IO happen on the
+        engine/reaper threads instead of the asyncio loop. The wire
+        format is transport-independent, so either side may be an
+        asyncio peer."""
         conn = self._worker_conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
@@ -1736,12 +1867,24 @@ class CoreWorker:
                 asyncio.get_running_loop().create_future()
             )
             try:
-                reader, writer = await rpc.open_connection(addr)
-                conn = rpc.Connection(
-                    reader, writer, rpc.handler_table(self),
-                    name=f"->{addr[-20:]}",
-                )
-                conn.start()
+                if (
+                    GLOBAL_CONFIG.native_wire
+                    and GLOBAL_CONFIG.native_push_conns
+                    and _conduit_available()
+                ):
+                    from ray_tpu._private.conduit_rpc import connect_conduit
+
+                    conn = await connect_conduit(
+                        addr, handler=rpc.handler_table(self),
+                        name=f"->{addr[-20:]}",
+                    )
+                else:
+                    reader, writer = await rpc.open_connection(addr)
+                    conn = rpc.Connection(
+                        reader, writer, rpc.handler_table(self),
+                        name=f"->{addr[-20:]}",
+                    )
+                    conn.start()
                 self._worker_conns[addr] = conn
             except BaseException as e:
                 if not pending.done():
@@ -2319,7 +2462,7 @@ class CoreWorker:
                 continue
             oid = ObjectID(bytes(a[1]))
             e = self.memory_store.get(oid)
-            if e is not None and e.event.is_set() and e.kind != "plasma":
+            if e is not None and e.ready and e.kind != "plasma":
                 continue
             if not self.store.contains(oid):
                 return True
@@ -2513,7 +2656,7 @@ class CoreWorker:
                 if self.store.contains(ref.id):
                     return
                 e = self.memory_store.get(ref.id)
-                if e is not None and e.event.is_set():
+                if e is not None and e.ready:
                     return  # resolved via the owner (value or error)
                 await asyncio.sleep(0.2)
             # still missing: _decode_args will drive recovery/errors
@@ -3110,6 +3253,7 @@ class CoreWorker:
                 )
         returns = []
         contained_map: Dict[int, List] = {}
+        inline_cap = GLOBAL_CONFIG.task_inline_return_bytes
         for idx, (oid, value) in enumerate(zip(spec.return_ids(), values)):
             meta, views, total = serialization.packed_size(value)
             contained = serialization.take_contained_refs()
@@ -3120,7 +3264,9 @@ class CoreWorker:
                     [r.binary(), r.owner_address] for r in contained
                 ]
                 self._pin_handoff(contained)
-            if total > GLOBAL_CONFIG.inline_object_max_bytes:
+            if inline_cap <= 0 or total > inline_cap:
+                # store-backed return ("p"): the owner pulls the bytes —
+                # also the interop fallback shape when inlining is off
                 buf = self._create_with_spill(oid, total)
                 try:
                     serialization.pack_into(meta, views, buf)
@@ -3131,8 +3277,13 @@ class CoreWorker:
                 self.gcs.call("add_object_location", [oid.binary(), self.node_id])
                 returns.append(["p", b""])
             else:
+                # inlined return ("v"): rides INSIDE the completion frame
+                # (task_done / task_done_batch) — no put+pin+get round
+                # trip anywhere on the path
                 out = bytearray(total)
                 serialization.pack_into(meta, views, memoryview(out))
+                self.task_inline_hits += 1
+                self.task_inline_bytes += total
                 returns.append(["v", bytes(out)])
         reply = {"returns": returns}
         if contained_map:
@@ -3159,6 +3310,15 @@ class CoreWorker:
 
     async def rpc_ping(self, conn, _):
         return "pong"
+
+    async def rpc_task_stats(self, conn, _):
+        """Task-plane counters (the raylet aggregates these per node
+        into node_stats["task_plane"]; the perf bench reads the driver's
+        own instance for its micro detail)."""
+        return {
+            "task_inline_hits": self.task_inline_hits,
+            "task_inline_bytes": self.task_inline_bytes,
+        }
 
     def as_future(self, ref: ObjectRef):
         import concurrent.futures

@@ -24,19 +24,32 @@ class _PrefixCounter:
     """Cheap unique 12-byte prefixes: one urandom seed per (process,
     fork), then a counter — os.urandom per task id is measurable at
     10k submissions/s. 6 random bytes namespace the process; 6 counter
-    bytes give 2^48 ids before wrap."""
+    bytes give 2^48 ids before wrap.
+
+    Fork safety rides ``os.register_at_fork`` instead of an
+    ``os.getpid()`` probe per id — the syscall was measurable on the
+    submission hot path at envelope task rates."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._pid = None
         self._seed = b""
         self._count = None
 
+    def _reset(self):
+        """Fork-child reinitialization. The inherited lock may have been
+        snapshotted HELD by a submitter thread that does not exist in
+        the child — acquiring it here would deadlock the child inside
+        the atfork handler, so install a fresh lock (the child is
+        single-threaded at this point) and then reseed."""
+        self._lock = threading.Lock()
+        self._seed = os.urandom(6)
+        self._count = itertools.count(
+            int.from_bytes(os.urandom(4), "big")
+        )
+
     def next_prefix(self) -> bytes:
-        pid = os.getpid()
         with self._lock:
-            if pid != self._pid:  # new process/fork: fresh namespace
-                self._pid = pid
+            if self._count is None:
                 self._seed = os.urandom(6)
                 self._count = itertools.count(
                     int.from_bytes(os.urandom(4), "big")
@@ -47,6 +60,7 @@ class _PrefixCounter:
 
 
 _prefixes = _PrefixCounter()
+os.register_at_fork(after_in_child=_prefixes._reset)
 
 
 class BaseID:

@@ -169,6 +169,17 @@ def run_microbenchmarks(
 
     out: Dict[str, float] = {}
 
+    # inline-counter baseline: the counters are process-cumulative, and
+    # the bench may run in an already-busy driver — report the DELTA
+    # over this measured section so it corresponds to the rates beside it
+    try:
+        from ray_tpu._private.worker import global_worker as _gw
+
+        _inline_base = (_gw.core_worker.task_inline_hits,
+                        _gw.core_worker.task_inline_bytes)
+    except Exception:
+        _inline_base = (0, 0)
+
     # single-client task throughput, batched submission (ray_perf
     # "tasks per second" timers)
     def burst_tasks():
@@ -228,6 +239,18 @@ def run_microbenchmarks(
     out["get_gbps"] = round(gets_per_s * put_mb / 1024, 3)
     del refs
 
+    # task-return inlining counters (owner side: every "v" completion
+    # materialized from a task_done frame above counts) — the bench
+    # gate records these next to the rates they explain
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        cw = global_worker.core_worker
+        out["task_inline_hits"] = cw.task_inline_hits - _inline_base[0]
+        out["task_inline_bytes"] = cw.task_inline_bytes - _inline_base[1]
+    except Exception:
+        pass
+
     # inter-node object plane: two-raylet loopback pull — same-host shm
     # fast path (default) and the socket plane (windowed + striped +
     # zero-copy chunk frames) — isolated in a subprocess
@@ -243,9 +266,12 @@ def run_microbenchmarks(
 
 def main():
     import json
+    import os
 
     import ray_tpu
 
+    os.environ.setdefault("RAYTPU_LEASE_PUSH_PIPELINE_DEPTH", "16")
+    os.environ.setdefault("RAYTPU_LEASE_KEEPALIVE_MS", "100")
     started = not ray_tpu.is_initialized()
     if started:
         ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)

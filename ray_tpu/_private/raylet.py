@@ -34,6 +34,13 @@ from ray_tpu._private.protocol import NodeInfo
 logger = logging.getLogger(__name__)
 
 
+class _LocationMiss(Exception):
+    """A pull peer answered 'I no longer hold a copy' — a LOCATION
+    miss, not a transport fault: the conn is healthy (keep it pooled),
+    same-peer chunk retries cannot help, and the cure is refreshing
+    object locations at the next full pull attempt."""
+
+
 class _PullSink:
     """Write-into-place target + arrival ledger for one striped pull.
 
@@ -285,6 +292,11 @@ class Raylet:
         self._pull_chunks_inflight = 0
         self._pull_aborts = 0
         self._transfer_chunk_retries = 0
+        # node_stats task-plane aggregation cache (monotonic ts, dict):
+        # bounds the per-stats-call fan-out to the worker pool
+        self._task_plane_cache: Tuple[float, Dict] = (0.0, {
+            "task_inline_hits": 0, "task_inline_bytes": 0,
+        })
         # live inbound transfers: deposit token -> _PullSink (chunk
         # frames route to their transfer by the token they carry)
         self._transfers: Dict[int, _PullSink] = {}
@@ -1868,7 +1880,7 @@ class Raylet:
                 timeout=timeout_s,
             )
             if reply is None:
-                raise ValueError("peer lost its copy mid-pull")
+                raise _LocationMiss(oid.hex())
 
         async def fetch_legacy(conn, todo):
             """Per-chunk fallback for peers without the batch endpoint."""
@@ -1885,7 +1897,7 @@ class Raylet:
                     timeout=timeout_s,
                 )
                 if meta is None:
-                    raise ValueError("peer lost its copy mid-pull")
+                    raise _LocationMiss(oid.hex())
                 if native_sink:
                     sink_target.record(off, n)
 
@@ -1904,11 +1916,13 @@ class Raylet:
                 self._pull_chunks_inflight += len(batch)
                 err = None
                 try:
-                    for i in range(chunk_tries):
+                    attempt = 0
+                    while attempt < chunk_tries:
                         todo = [r for r in batch if landed.get(r[0]) != r[1]]
                         if not todo:
                             break
-                        if i:
+                        attempt += 1
+                        if attempt > 1:
                             # a chaos-dropped frame costs one timeout,
                             # not the whole striped attempt
                             self._transfer_chunk_retries += 1
@@ -1917,11 +1931,23 @@ class Raylet:
                                 await fetch_legacy(conn, todo)
                             else:
                                 await fetch_batch(conn, todo)
+                        except _LocationMiss as e:
+                            # the peer no longer HOLDS a copy: a
+                            # location miss, not a transport fault —
+                            # retrying this peer cannot help, its
+                            # pooled conn is healthy (keep it), and the
+                            # outer pull attempt refreshes locations
+                            err = e
+                            break
                         except rpc.RpcError as e:
                             if "unknown method" in str(e) and not (
                                 state.get("legacy")
                             ):
                                 state["legacy"] = True  # pre-batch peer
+                                # the fallback probe must not burn a
+                                # retry: at chunk_retries=0 the legacy
+                                # path still gets its one attempt
+                                attempt -= 1
                                 continue
                             err = e
                             break
@@ -1934,6 +1960,12 @@ class Raylet:
                     ]
                     if missing:
                         state["failed"] = True
+                        # per-CAUSE verdict: only a batch whose failure
+                        # was NOT a pure location miss implicates the
+                        # transport (a concurrent batch may time out on
+                        # this same conn while another sees the miss)
+                        if not isinstance(err, _LocationMiss):
+                            state["transport_fault"] = True
                         if not state.get("logged"):
                             state["logged"] = True
                             logger.warning(
@@ -1972,8 +2004,15 @@ class Raylet:
                 if tasks:
                     await asyncio.gather(*tasks, return_exceptions=True)
             finally:
+                # a lost-copy peer FAILED the pull (its ranges handed
+                # over to survivors) but its connection is perfectly
+                # healthy — discard only when some batch implicated the
+                # TRANSPORT (timeouts/errors that were not location
+                # misses), so a conn that both missed a copy and wedged
+                # still gets discarded
                 self._peer_pool.release(
-                    addr, conn, discard=state["failed"]
+                    addr, conn,
+                    discard=bool(state.get("transport_fault")),
                 )
             return not state["failed"]
 
@@ -2263,6 +2302,42 @@ class Raylet:
             self.store.release(oid)
 
     # ------------- introspection -------------
+    async def _task_plane_stats(self) -> Dict:
+        """Aggregate task-plane counters from every registered worker
+        and driver over their registration conns (best-effort: a dying
+        worker just drops out of the sum). Cached for 2s: node_stats is
+        polled by the autoscaler/status paths every tick, and the
+        fan-out must not multiply control-plane RPCs per poll (nor let
+        one unresponsive worker conn tax every caller)."""
+        ts, cached = self._task_plane_cache
+        now = time.monotonic()
+        if now - ts < 2.0:
+            return cached
+        # stamp BEFORE the fan-out: concurrent node_stats callers in the
+        # refresh window serve the stale dict instead of each re-running
+        # the per-worker gather (single-flight-ish; a lost race just
+        # refreshes twice)
+        self._task_plane_cache = (now, cached)
+        conns = [w.conn for w in self.workers.values()
+                 if w.conn is not None and not w.conn.closed]
+        conns += [c for c in self.drivers.values() if not c.closed]
+
+        async def one(c):
+            try:
+                return await c.call_async("task_stats", None, timeout=1)
+            except Exception:
+                return None
+
+        out = {"task_inline_hits": 0, "task_inline_bytes": 0}
+        for r in await asyncio.gather(*(one(c) for c in conns)):
+            if r:
+                out["task_inline_hits"] += int(r.get("task_inline_hits", 0))
+                out["task_inline_bytes"] += int(
+                    r.get("task_inline_bytes", 0)
+                )
+        self._task_plane_cache = (now, out)
+        return out
+
     async def rpc_node_stats(self, conn, _):
         return {
             "node_id": self.node_id.hex(),
@@ -2276,6 +2351,7 @@ class Raylet:
             "objects_served": self._objects_served,
             "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
+            "task_plane": await self._task_plane_stats(),
             "transfer": {
                 "bytes_in": self._transfer_bytes_in,
                 "bytes_out": self._transfer_bytes_out,

@@ -801,6 +801,39 @@ int64_t cd_connect(void* h, const char* addr) {
   return c->id;
 }
 
+// Queue a batch of PRE-FRAMED frames ([u32 BE len][body] repeated; RAW
+// frames included verbatim) as ONE out-queue buffer: one mutex
+// acquisition, one memcpy, one eventfd wake and (typically) one writev
+// for a whole push burst — the task-plane hot path pays its per-frame
+// FFI/wakeup cost once per batch instead of once per task. The caller
+// guarantees the buffer is a valid concatenation of frames (the Python
+// cork builds it); the receiver parses them out individually, so the
+// wire is byte-identical to len(batch) cd_send calls and asyncio peers
+// interoperate unchanged. Returns queued bytes on the conn, or -1 if
+// the conn is gone.
+int64_t cd_push_batch(void* h, int64_t conn, const uint8_t* buf,
+                      uint64_t len) {
+  Engine* e = (Engine*)h;
+  size_t qb;
+  {
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->conns.find(conn);
+    if (it == e->conns.end()) return -1;
+    Conn* c = it->second;
+    if (len == 0) return (int64_t)c->out_bytes;  // empty burst: no-op
+    // (queueing a zero-length OutBuf would wedge flush_conn: its iov
+    // builder skips empty buffers, so the entry could never be popped)
+    OutBuf b;
+    b.data.resize(len);
+    memcpy(b.data.data(), buf, len);
+    c->outq.push_back(std::move(b));
+    c->out_bytes += len;
+    qb = c->out_bytes;
+  }
+  wake(e);
+  return (int64_t)qb;
+}
+
 // Queue one frame ([u32 len] header added here). Safe from any thread.
 // Returns queued bytes on the conn, or -1 if the conn is gone.
 int64_t cd_send(void* h, int64_t conn, const uint8_t* buf, uint32_t len) {

@@ -43,6 +43,10 @@ def _build_and_run(tmp_path, extra_flags):
     # EV_SENT tokens incl. abandoned-buffer delivery, dribbled raw
     # reassembly, oversized raw rejection) must have run
     assert "raw+iov ok" in run.stdout
+    # pre-framed burst section (r8 task-plane hot path): one
+    # cd_push_batch buffer must deliver its frames byte-intact, in
+    # order with interleaved per-frame sends, RAW frames included
+    assert "push-batch ok" in run.stdout
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")

@@ -157,6 +157,35 @@ def test_suppression_silences(rule):
     assert suppressed >= 1
 
 
+def test_r3_covers_conduit_batch_send():
+    """R3 extends to the r8 conduit-batch send path: a cork flush that
+    hands pre-framed bytes to ``engine.send_batch`` (or raw
+    ``cd_push_batch``) without consulting the chaos plane is exactly as
+    fault-schedule-breaking as a bare ``writer.write``."""
+    bad = textwrap.dedent(
+        """
+        def flush_cork(self):
+            buf, self._cork = self._cork, bytearray()
+            self.engine.send_batch(self.conn_id, bytes(buf))
+        """
+    )
+    findings, _ = lint_source(bad, "conduit_rpc.py")
+    assert any(f.rule == "R3" for f in findings)
+    good = textwrap.dedent(
+        """
+        from ray_tpu._private import chaos as _chaos
+        def send_notify_corked(self, method, data):
+            if _chaos._PLANE is not None:
+                copies, delay = _chaos._PLANE.decide(self.name, 0)
+                if copies == 0:
+                    return
+            self._cork += b"frame"
+        """
+    )
+    findings, _ = lint_source(good, "conduit_rpc.py")
+    assert findings == []
+
+
 def test_suppression_by_rule_name_and_def_line():
     path, bad, _ = CORPUS["R1"]
     src = textwrap.dedent(bad).replace(

@@ -1,0 +1,288 @@
+"""Task hot path (r8): inlined small returns + conduit-core batched
+dispatch.
+
+Covers the ISSUE-7 acceptance surface: the inline-size boundary at
+``task_inline_return_bytes``, oversized returns staying store-backed,
+the interop fallback (inlining disabled on either side = every return
+store-backed, results identical), refs to inlined values surviving
+executor death + re-execution and cross-node borrowing, chaos-soaked
+streamed pushes with inlining on, and a bounded envelope smoke (50k
+tasks queued before the first get, with RSS / raylet-queue / liveness
+bounds) — the 1M row lives in tests/test_scale.py.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+
+
+def _rss_bytes() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+def _driver_cw():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker.core_worker
+
+
+@pytest.fixture
+def rt_small_cap():
+    """Cluster with a 1 KiB inline-return cap so the boundary is cheap
+    to probe."""
+    ray_tpu.init(
+        num_cpus=1,
+        object_store_memory=128 * 1024 * 1024,
+        system_config={"task_inline_return_bytes": 1024},
+    )
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _payload_of_packed_size(target: int) -> bytes:
+    """bytes payload whose serialized wire form is exactly ``target``
+    (the pack overhead for bytes is size-independent past smallness)."""
+    overhead = len(serialization.pack(b"x" * 4096)) - 4096
+    payload = b"x" * (target - overhead)
+    assert len(serialization.pack(payload)) == target
+    return payload
+
+
+def test_inline_boundary_at_cap(rt_small_cap):
+    """A return packing to EXACTLY the cap rides inline in the
+    completion frame; one byte over goes store-backed — both correct."""
+    at_cap = _payload_of_packed_size(1024)
+    over_cap = at_cap + b"x"
+
+    @ray_tpu.remote
+    def echo(v):
+        return v
+
+    cw = _driver_cw()
+    base_hits = cw.task_inline_hits
+    ref_in = echo.remote(at_cap)
+    assert ray_tpu.get(ref_in, timeout=60) == at_cap
+    assert cw.task_inline_hits == base_hits + 1
+    e = cw.memory_store.get(ref_in.id)
+    assert e is not None and e.kind in ("packed", "value")
+
+    ref_out = echo.remote(over_cap)
+    assert ray_tpu.get(ref_out, timeout=60) == over_cap
+    assert cw.task_inline_hits == base_hits + 1  # no new inline hit
+    e = cw.memory_store.get(ref_out.id)
+    assert e is not None and e.kind == "plasma"
+    assert cw.store.contains(ref_out.id)  # store-backed on the node
+
+
+def test_inline_disabled_is_store_backed_fallback():
+    """``task_inline_return_bytes=0`` — the interop fallback shape —
+    forces every return through the store; results are identical."""
+    ray_tpu.init(
+        num_cpus=1,
+        object_store_memory=128 * 1024 * 1024,
+        system_config={"task_inline_return_bytes": 0},
+    )
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return {"i": i}
+
+        cw = _driver_cw()
+        base_hits = cw.task_inline_hits
+        out = ray_tpu.get([f.remote(i) for i in range(20)], timeout=60)
+        assert out == [{"i": i} for i in range(20)]
+        assert cw.task_inline_hits == base_hits  # nothing rode inline
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_mixed_version_interop_legacy_executor(rt):
+    """New owner against a MIXED worker pool where some executors are
+    'legacy' (never inline, simulated by zeroing the knob inside the
+    worker process): legacy workers answer store-backed ("p"), new ones
+    inline ("v"), and the owner — whose wire understands both elements
+    unconditionally — sees identical values either way. The all-legacy
+    pool is test_inline_disabled_is_store_backed_fallback; the
+    vice-versa direction (legacy owner + new executor) is the default
+    wire — "v" elements predate r8, so inline-capable replies parse on
+    an old owner unchanged."""
+
+    @ray_tpu.remote
+    def make_legacy():
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG._entries["task_inline_return_bytes"].value = 0
+        return os.getpid()
+
+    # legacify whichever workers serve these (a strict subset of the
+    # pool is fine — MIXED pools are the interesting interop case)
+    legacy_pids = set(ray_tpu.get(
+        [make_legacy.remote() for _ in range(8)], timeout=60
+    ))
+    assert legacy_pids
+
+    @ray_tpu.remote
+    def f(i):
+        return (i * 3, os.getpid())
+
+    cw = _driver_cw()
+    base_hits = cw.task_inline_hits
+    out = ray_tpu.get([f.remote(i) for i in range(40)], timeout=60)
+    assert [v for v, _pid in out] == [i * 3 for i in range(40)]
+    served_by_legacy = sum(1 for _v, pid in out if pid in legacy_pids)
+    inline_hits = cw.task_inline_hits - base_hits
+    # every non-legacy-served task rode inline; every legacy-served one
+    # fell back to the store — the two partitions must tile the batch
+    assert inline_hits == 40 - served_by_legacy, (
+        inline_hits, served_by_legacy
+    )
+
+
+def test_inlined_return_survives_executor_death(rt, tmp_path):
+    """A retried task whose first executor dies mid-run re-executes and
+    its small return still arrives inline — the retry path and the
+    inline path compose. No guesswork about which worker ran it: the
+    task publishes its own pid before sleeping, the test kills exactly
+    that process, and the pid file proves a second execution actually
+    happened."""
+    pid_file = tmp_path / "executor_pids"
+
+    @ray_tpu.remote(max_retries=3)
+    def slow_small(path):
+        import os as _os
+        import time as _t
+
+        with open(path, "a") as f:
+            f.write(f"{_os.getpid()}\n")
+        _t.sleep(3)
+        return {"ok": 41 + 1}
+
+    ref = slow_small.remote(str(pid_file))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if pid_file.exists() and pid_file.read_text().strip():
+            break
+        time.sleep(0.05)
+    victim = int(pid_file.read_text().splitlines()[0])
+    os.kill(victim, 9)  # the executor, mid-sleep, before its reply
+    assert ray_tpu.get(ref, timeout=120)["ok"] == 42
+    # the value came from a RE-execution, not the killed attempt
+    assert len(pid_file.read_text().splitlines()) >= 2
+
+
+def test_inlined_value_borrowable_cross_node():
+    """A ref to an inlined return used as an arg on ANOTHER node: the
+    executor's staging falls back to the owner's get_object, which
+    serves the stored wire bytes directly (the 'packed' entry needs no
+    re-pack)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+    )
+    c.add_node(num_cpus=2, resources={"other": 1})
+    c.connect()
+    try:
+        @ray_tpu.remote(resources={"head": 0.1})
+        def produce():
+            return {"payload": list(range(32))}
+
+        @ray_tpu.remote(resources={"other": 0.1})
+        def consume(v):
+            return sum(v["payload"])
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref, timeout=60)["payload"][5] == 5
+        assert ray_tpu.get(consume.remote(ref), timeout=120) == sum(
+            range(32)
+        )
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+@pytest.mark.chaos
+def test_chaos_streamed_pushes_with_inlining():
+    """Streamed pushes with inlining on while every GCS link runs
+    drop/dup/delay chaos: the control plane rides its replay machinery,
+    the task plane keeps its ordered conns, and the small returns still
+    ride inline (hits counted)."""
+    from ray_tpu._private import chaos
+    from ray_tpu._private.test_utils import network_chaos
+
+    spec = chaos.make_spec(
+        seed=808, link="gcs", drop=0.05, dup=0.02, delay_ms=(2, 10)
+    )
+    with network_chaos(spec):
+        ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+        try:
+            @ray_tpu.remote(max_retries=10)
+            def f(i):
+                return i + 1
+
+            out = ray_tpu.get([f.remote(i) for i in range(80)], timeout=120)
+            assert out == [i + 1 for i in range(80)]
+            cw = _driver_cw()
+            assert cw.task_inline_hits >= 80
+            live = chaos.plane()
+            assert live.stats["frames"] > 0
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_envelope_smoke_50k_queued():
+    """Bounded tier-1 variant of the 1M slow soak: 50k no-arg tasks all
+    submitted before the first get. Asserts (1) results correct, (2)
+    driver RSS growth stays far below a runaway per-task footprint,
+    (3) the raylet lease queue stays bounded by the owner-side
+    in-flight cap (a 50k-deep owner queue must not park 50k lease
+    requests at the raylet), and (4) the raylet event loop stays live
+    under queue pressure (a stats round trip answers while the queue
+    is deep)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        from ray_tpu._private import rpc as _rpc
+        from ray_tpu._private.worker import global_worker
+
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        total = 50_000
+        rss0 = _rss_bytes()
+        refs = [inc.remote(i) for i in range(total)]
+        rss_submit = _rss_bytes()
+        # liveness + queue bound probed while the queue is still deep
+        raylet_addr = global_worker.core_worker.raylet._addr
+        cli = _rpc.Client.connect(raylet_addr, name="envelope-probe")
+        t0 = time.monotonic()
+        stats = cli.call("node_stats", None, timeout=30)
+        stats_rtt = time.monotonic() - t0
+        assert stats_rtt < 10.0, f"raylet stalled under queue pressure: {stats_rtt:.1f}s"
+        assert stats["queue_len"] <= 256, stats["queue_len"]
+        cli.close()
+        chunk = 10_000
+        for lo in range(0, total, chunk):
+            out = ray_tpu.get(refs[lo:lo + chunk], timeout=600)
+            assert out[0] == lo + 1 and out[-1] == lo + chunk
+            refs[lo:lo + chunk] = [None] * chunk
+        rss_end = _rss_bytes()
+        # ~50k pending tasks should cost well under 2 KiB each in the
+        # driver (specs + pending entries + refs); 500 MiB of growth
+        # would mean a per-task footprint regression of ~10x
+        assert rss_submit - rss0 < 500 * 1024 * 1024, (
+            f"driver RSS grew {(rss_submit - rss0) / 1e6:.0f} MB during "
+            f"50k-task submission"
+        )
+        assert rss_end - rss0 < 600 * 1024 * 1024
+    finally:
+        ray_tpu.shutdown()

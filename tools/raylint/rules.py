@@ -248,7 +248,9 @@ def _check_r3(tree: ast.AST, path: str, func_of,
             if (name.endswith("writer.write")
                     or name.endswith("engine.send")
                     or name.endswith("engine.send_iov")
-                    or name == "cd_send"):
+                    or name.endswith("engine.send_batch")
+                    or name == "cd_send"
+                    or name == "cd_push_batch"):
                 findings.append(Finding(
                     path, node.lineno, node.col_offset, "R3",
                     f"wire send {name}() in {fn.name} bypasses the "
