@@ -447,6 +447,29 @@ def main():
                 transfer_mb=256,
             )
             micro["data_ingest"] = run_data_ingest_bench()
+            # serving plane (r9): sustained open-loop streamed traffic
+            # against an SLO-autoscaled 1->N deployment behind the
+            # shared Router actor, + the broadcast-tree weight fan-out
+            # (K replicas pulling one weights object, source egress
+            # must stay O(fanout) not O(K)). Subprocess-isolated.
+            from ray_tpu._private.ray_perf import (
+                run_broadcast_bench,
+                run_serving_scale_bench,
+            )
+
+            try:
+                micro["serving_scale"] = run_serving_scale_bench()
+                micro["serving_tokens_per_s_per_replica"] = (
+                    micro["serving_scale"]["tokens_per_s_per_replica"]
+                )
+            except Exception as e:
+                micro["serving_scale"] = {"error": str(e)[:160]}
+            try:
+                micro["weight_fanout"] = run_broadcast_bench(
+                    size_mb=64, k=4
+                )
+            except Exception as e:
+                micro["weight_fanout"] = {"error": str(e)[:160]}
             if accel_unreachable:
                 # the RL learner uses driver-side jax, which the wedged
                 # probe thread may deadlock — everything above is numpy
@@ -487,6 +510,10 @@ def main():
         # (transfer_socket_gbps) is recorded but not ratcheted — its
         # run-to-run variance on a timeshared box would flake the gate.
         "transfer_gbps": 0.3,
+        # serving plane (r9): streamed tokens/s/replica under open-loop
+        # traffic against the autoscaled deployment (dev box ~85-90;
+        # floor at roughly half, ratchet owns same-box regressions)
+        "serving_tokens_per_s_per_replica": 40.0,
     }
     floors = ratchet_floors(STATIC_FLOORS)
     violations = []
@@ -503,6 +530,38 @@ def main():
                 "metric": "data_ingest_speedup",
                 "value": ingest.get("speedup"), "floor": 10.0,
             })
+        # serving-plane contract (r9): the deployment must actually have
+        # scaled out on SLO burn, post-scale p95 TTFT must sit inside a
+        # generous static ceiling (ratcheting a latency DOWN rides the
+        # tokens/s floor instead), and backpressure rejections must stay
+        # bounded — observable, not unbounded queueing OR mass rejection.
+        sv = micro.get("serving_scale") or {}
+        if "error" not in sv and sv:
+            if sv.get("replicas_final", 0) < 2:
+                violations.append({
+                    "metric": "serving_scale_replicas",
+                    "value": sv.get("replicas_final"), "floor": 2,
+                })
+            if (sv.get("steady_ttft_p95_ms") or 1e9) > 1500.0:
+                violations.append({
+                    "metric": "serving_steady_ttft_p95_ms",
+                    "value": sv.get("steady_ttft_p95_ms"),
+                    "floor": "<= 1500",
+                })
+            if (sv.get("rejected_ratio") or 0.0) > 0.3:
+                violations.append({
+                    "metric": "serving_rejected_ratio",
+                    "value": sv.get("rejected_ratio"), "floor": "<= 0.3",
+                })
+        wf = micro.get("weight_fanout") or {}
+        if "error" not in wf and wf:
+            # the broadcast tree's reason to exist: K=4 pulls must not
+            # cost the source anywhere near 4 copies
+            if (wf.get("egress_ratio") or 1e9) > 2.5:
+                violations.append({
+                    "metric": "weight_fanout_egress_ratio",
+                    "value": wf.get("egress_ratio"), "floor": "<= 2.5",
+                })
     if on_accel:
         mfu_floor = max(0.40, 0.98 * best_prior_mfu())
         if mfu < mfu_floor:

@@ -124,6 +124,16 @@ _d("object_transfer_retries", int, 3)
 # attaching it and copying arena-to-arena — no sockets (the reference
 # shares plasma objects between same-node workers the same way)
 _d("object_transfer_same_host_shm", bool, True)
+# broadcast tree: K raylets pulling the SAME large object form a k-ary
+# pull tree over the GCS pull registry (pull_begin/pull_end) — children
+# stream chunk ranges off an ancestor's IN-PROGRESS pull (partial serve)
+# instead of K-x'ing the source NIC (reference pull-manager dedup role).
+# This is the fanout k; 0 disables the tree (every puller hits a sealed
+# location directly).
+_d("object_broadcast_fanout", int, 2)
+# objects below this size skip the tree (a sub-chunk object gains
+# nothing from riding behind a parent's pull)
+_d("object_broadcast_min_bytes", int, 16 * 1024 * 1024)
 # how many tasks an owner keeps in flight per lease. DEFAULT 1: a task
 # blocked in a nested get() must not strand tasks committed behind it on
 # the same serial worker (they would get their own leases instead).

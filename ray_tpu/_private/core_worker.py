@@ -3103,7 +3103,11 @@ class CoreWorker:
         tb = traceback.format_exc()
         self._emit_task_event(spec, "FAILED", error=str(e))
         err = exc.TaskError(
-            function_name=spec.name, traceback_str=tb, cause=None
+            function_name=spec.name, traceback_str=tb,
+            # typed framework errors (BackpressureError & co.) must reach
+            # the caller as objects; arbitrary user exceptions ride along
+            # when picklable (the except below degrades to text if not)
+            cause=e if isinstance(e, exc.RayTpuError) else None,
         )
         try:
             packed = serialization.pack(exc.ErrorObject(err))

@@ -254,6 +254,12 @@ class GcsServer:
         self.task_events: Dict[bytes, Dict] = {}  # insertion-ordered
         # pubsub: channel -> set of connections
         self.subs: Dict[str, Set[rpc.Connection]] = {}
+        # broadcast-tree pull registry: oid -> in-progress puller node
+        # ids in ARRIVAL ORDER (transient — not journaled; a GCS restart
+        # just degrades concurrent pulls to direct source fetches until
+        # they re-register). Parents are always EARLIER arrivals, so the
+        # assignment can never cycle.
+        self._pulls: Dict[bytes, List[bytes]] = {}
         self._raylet_clients: Dict[bytes, rpc.Connection] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._started = asyncio.Event()
@@ -1299,6 +1305,69 @@ class GcsServer:
         locs = self.kv.get("loc:" + oid.hex())
         return rpc.msgpack.unpackb(locs) if locs else []
 
+    # ---------------- broadcast-tree pull registry ----------------
+    # K raylets pulling one large object register here; each is assigned
+    # a tree PARENT (an earlier in-progress puller) to stream from, so
+    # the sealed source serves O(fanout) copies instead of K (reference
+    # pull-manager dedup / push-manager fan-out role). The raylet-side
+    # partial-serve path (raylet.rpc_read_object_chunks) makes an
+    # in-progress pull a valid chunk source.
+
+    async def rpc_pull_begin(self, conn, data):
+        """Register ``node_id`` as pulling ``oid``; returns sealed
+        locations plus the assigned tree parents. Re-registration keeps
+        the node's arrival position, so a retrying puller walks UP its
+        ancestor chain (skipping ``exclude`` + dead nodes) instead of
+        being reshuffled below a later arrival (which could cycle)."""
+        oid, node_id = bytes(data[0]), bytes(data[1])
+        exclude = {bytes(x) for x in (data[2] if len(data) > 2 else [])}
+        locs = self.kv.get("loc:" + oid.hex())
+        locs = rpc.msgpack.unpackb(locs) if locs else []
+        sealed = {bytes(x) for x in locs}
+        fanout = max(1, int(GLOBAL_CONFIG.object_broadcast_fanout or 1))
+        lst = self._pulls.setdefault(oid, [])
+        # prune dead pullers IN PLACE (relative order — and with it the
+        # no-cycle invariant — is preserved)
+        lst[:] = [
+            n for n in lst
+            if n in self.nodes and self.nodes[n].alive
+        ]
+        if node_id not in lst:
+            lst.append(node_id)
+        pos = lst.index(node_id)
+        # k-ary heap walk: nearest live, non-excluded ancestor serves as
+        # parent; position 0 (or no usable ancestor) pulls the source
+        parent = None
+        p = pos
+        while p > 0:
+            p = (p - 1) // fanout
+            cand = lst[p]
+            if (cand not in exclude and cand not in sealed
+                    and cand != node_id):
+                parent = cand
+                break
+        return {
+            "locations": [bytes(x) for x in locs],
+            "parents": [parent] if parent is not None else [],
+            "position": pos,
+        }
+
+    async def rpc_pull_end(self, conn, data):
+        """Deregister a finished/aborted puller. Success is implicit —
+        the puller adds a sealed location separately; children it was
+        serving re-register and find it there (or another ancestor)."""
+        oid, node_id = bytes(data[0]), bytes(data[1])
+        lst = self._pulls.get(oid)
+        if lst is None:
+            return False
+        try:
+            lst.remove(node_id)
+        except ValueError:
+            return False
+        if not lst:
+            self._pulls.pop(oid, None)
+        return True
+
     async def rpc_free_object(self, conn, oid_bytes: bytes):
         """Owner freed its last reference: delete every copy — in-store AND
         spilled — on every node that holds one (parity: reference
@@ -1306,6 +1375,7 @@ class GcsServer:
         to copy-holding raylets."""
         key = "loc:" + oid_bytes.hex()
         locs = self.kv.pop(key, None)
+        self._pulls.pop(bytes(oid_bytes), None)  # freed: entry is moot
         if locs is not None:
             self._journal(["kv", key, None])
         nodes = (

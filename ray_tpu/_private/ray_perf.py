@@ -141,6 +141,248 @@ def run_transfer_bench(size_mb: int = 256) -> Dict[str, float]:
     )
 
 
+_BROADCAST_BENCH_CODE = """
+import json, sys, threading, time
+import numpy as np
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private import rpc
+
+size_mb = int(sys.argv[1])
+k = int(sys.argv[2])
+store = max(size_mb * 2, 192) * 1024 * 1024
+
+c = Cluster(
+    initialize_head=True,
+    head_node_args={"resources": {"CPU": 2, "head": 1}},
+    system_config={
+        "object_store_memory_bytes": store,
+        "object_transfer_same_host_shm": False,  # exercise the NIC plane
+        "object_broadcast_min_bytes": 4 * 1024 * 1024,
+        "prestart_workers": False,
+        "log_to_driver": False,
+    },
+)
+try:
+    nodes = [c.add_node(num_cpus=1, resources={f"p{i}": 1})
+             for i in range(k)]
+    c.connect()
+    arr = np.random.randint(0, 255, size_mb * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(arr)
+    info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+    head_hex = c.head_node.node_id.hex()
+    cli_head = rpc.Client.connect(info[head_hex]["raylet_addr"],
+                                  name="bb-head")
+    clis = [rpc.Client.connect(info[n.node_id.hex()]["raylet_addr"],
+                               name=f"bb-{i}") for i, n in enumerate(nodes)]
+    for cl in clis + [cli_head]:
+        cl.call("node_stats", None, timeout=30)  # warm the conns
+    base_out = cli_head.call(
+        "node_stats", None, timeout=30)["transfer"]["bytes_out"]
+    results = [None] * k
+
+    def pull(i):
+        t0 = time.perf_counter()
+        ok = clis[i].call("pull_object", ref.binary(), timeout=600,
+                          retry=False)
+        results[i] = (ok, time.perf_counter() - t0)
+
+    t_start = time.perf_counter()
+    ts = [threading.Thread(target=pull, args=(i,)) for i in range(k)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t_start
+    assert all(r and r[0] is True for r in results), results
+    head_out = cli_head.call(
+        "node_stats", None, timeout=30)["transfer"]["bytes_out"] - base_out
+    tree_pulls = sum(
+        cl.call("node_stats", None, timeout=30)["transfer"]["tree_pulls"]
+        for cl in clis
+    )
+    print(json.dumps({
+        "fanout_seconds": round(wall, 3),
+        "egress_ratio": round(head_out / arr.nbytes, 2),
+        "aggregate_gbps": round(k * arr.nbytes / wall / 1e9, 3),
+        "tree_pulls": tree_pulls,
+        "k": k,
+        "size_mb": size_mb,
+    }))
+finally:
+    c.shutdown()
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+"""
+
+
+def run_broadcast_bench(size_mb: int = 64, k: int = 4) -> Dict[str, float]:
+    """Broadcast-tree weight fan-out: ``k`` raylets concurrently pull one
+    ``size_mb`` MiB object (the scale-up shape: K new replicas fetching
+    the same weights). Records the fan-out wall seconds and the SOURCE
+    egress ratio — the tree's whole point is that ratio staying O(fanout)
+    instead of K. Subprocess-isolated like the transfer bench."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RAYTPU_CHAOS_SPEC", None)
+    env.pop("RAYTPU_ADDRESS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _BROADCAST_BENCH_CODE, str(size_mb), str(k)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"broadcast bench produced no result (rc={r.returncode}): "
+        f"{r.stderr[-500:]}"
+    )
+
+
+_SERVING_SCALE_CODE = """
+import json, threading, time
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=8, object_store_memory=192 * 1024 * 1024)
+try:
+    TOKENS = 25
+    TOK_S = 0.02  # per-token service time -> ~1250 tok/s ceiling/replica
+
+    @serve.deployment(
+        max_ongoing_requests=4,
+        max_queued_requests=64,
+        max_queue_wait_s=20.0,
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 4,
+            "ttft_slo_ms": 300.0,
+            "upscale_delay_s": 1.0,
+            "downscale_delay_s": 120.0,
+        },
+        ray_actor_options={"num_cpus": 0.25},
+    )
+    class TokenStream:
+        def stream(self, req):
+            for i in range(req["tokens"]):
+                time.sleep(TOK_S)
+                yield i
+
+    h = serve.run(TokenStream.bind())
+    # warm one stream end to end (replica boot off the clock)
+    assert sum(1 for _ in h.stream({"tokens": 2})) == 2
+
+    DURATION = 16.0
+    RATE = 11.0  # open-loop arrivals/s: ~2x one replica's capacity
+    lock = threading.Lock()
+    ttfts, rejected, failed, tokens_done = [], [0], [0], [0]
+    stop_at = time.monotonic() + DURATION
+
+    def client(delay):
+        time.sleep(delay)
+        t0 = time.monotonic()
+        try:
+            it = h.stream({"tokens": TOKENS})
+            got = 0
+            for i, _ in enumerate(it):
+                if i == 0:
+                    with lock:
+                        ttfts.append((time.monotonic() - t0, t0))
+                got += 1
+            with lock:
+                tokens_done[0] += got
+        except serve.BackpressureError:
+            with lock:
+                rejected[0] += 1
+        except Exception:
+            with lock:
+                failed[0] += 1
+
+    n = int(DURATION * RATE)
+    threads = [
+        threading.Thread(target=client, args=(i / RATE,)) for i in range(n)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.monotonic() - t_start
+
+    ctrl = serve._get_or_start_controller()
+    m = ray_tpu.get(
+        ctrl.deployment_metrics.remote("TokenStream"), timeout=30
+    )
+    replicas = m.get("num_replicas", 1)
+    # steady-state TTFT: samples from the second half of the run (the
+    # scale-up transient is the first half's story)
+    mid = t_start + DURATION / 2
+    late = sorted(t for t, at in ttfts if at >= mid)
+    all_t = sorted(t for t, _ in ttfts)
+    pct = lambda v, q: v[min(len(v) - 1, int(len(v) * q))] * 1e3 if v else None
+    print(json.dumps({
+        "submitted": n,
+        "completed": len(ttfts),
+        "rejected": rejected[0],
+        "failed": failed[0],
+        "replicas_final": replicas,
+        "ttft_p50_ms": round(pct(all_t, 0.50) or 0, 1),
+        "ttft_p95_ms": round(pct(all_t, 0.95) or 0, 1),
+        "steady_ttft_p95_ms": round(pct(late, 0.95) or 0, 1),
+        "tokens_per_s": round(tokens_done[0] / wall, 1),
+        "tokens_per_s_per_replica": round(
+            tokens_done[0] / wall / max(1, replicas), 1
+        ),
+        "rejected_ratio": round(rejected[0] / n, 3),
+        "router": {
+            k: v for k, v in m.items()
+            if k in ("ongoing", "queued", "rejected_total", "routed_total",
+                     "ttft_p95_ms")
+        },
+    }))
+finally:
+    ray_tpu.shutdown()
+"""
+
+
+def run_serving_scale_bench() -> Dict[str, float]:
+    """Serving-plane scale bench: sustained open-loop streamed traffic
+    against an SLO-autoscaled deployment behind the shared Router actor.
+    The deployment starts at 1 replica; the arrival rate is ~2x one
+    replica's capacity, so the run only meets its TTFT floor if the
+    TTFT-SLO burn actually scales it out — and bounded backpressure
+    rejections are part of the recorded contract. Subprocess-isolated
+    (own cluster, CPU-pinned jax) like the transfer bench."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RAYTPU_CHAOS_SPEC", None)
+    env.pop("RAYTPU_ADDRESS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _SERVING_SCALE_CODE],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"serving_scale bench produced no result (rc={r.returncode}): "
+        f"{r.stderr[-500:]}"
+    )
+
+
 def run_microbenchmarks(
     *,
     tasks_n: int = 200,

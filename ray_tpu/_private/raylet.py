@@ -47,15 +47,21 @@ class _PullSink:
     Chunk frames land from transport threads (conduit reaper / IO loop):
     inline payloads copy straight into the store buffer here, native
     deposits just record. The lock serializes writes against the abort
-    path, so a straggler chunk can never land in a freed store slot."""
+    path, so a straggler chunk can never land in a freed store slot.
 
-    __slots__ = ("_buf", "_lock", "closed", "landed")
+    The ledger doubles as the broadcast tree's PARTIAL-SERVE source:
+    ``covered``/``read`` let this raylet serve already-landed ranges of
+    an in-progress pull onward to child pullers."""
 
-    def __init__(self, buf):
+    __slots__ = ("_buf", "_lock", "closed", "landed", "size", "chunk")
+
+    def __init__(self, buf, size: int = 0, chunk: int = 0):
         self._buf = buf
         self._lock = threading.Lock()
         self.closed = False
         self.landed: Dict[int, int] = {}  # chunk off -> bytes landed
+        self.size = size
+        self.chunk = chunk
 
     def write(self, off: int, mv) -> bool:
         """Copy one chunk payload straight into the store buffer (the
@@ -71,6 +77,28 @@ class _PullSink:
         with self._lock:
             if not self.closed:
                 self.landed[off] = n
+
+    def covered(self, off: int, n: int) -> bool:
+        """True when every pull-grid chunk overlapping [off, off+n) has
+        fully landed (a stale False just makes the caller poll again)."""
+        c = self.chunk
+        if c <= 0 or n <= 0:
+            return False
+        pos = (off // c) * c
+        end = off + n
+        while pos < end:
+            if self.landed.get(pos) != min(c, self.size - pos):
+                return False
+            pos += c
+        return True
+
+    def read(self, off: int, n: int) -> Optional[bytes]:
+        """Copy landed bytes out for partial serving (None once closed —
+        the buffer is being sealed or aborted)."""
+        with self._lock:
+            if self.closed or self._buf is None:
+                return None
+            return bytes(self._buf[off : off + n])
 
     def close(self):
         """Stop accepting writes and drop the buffer reference (called
@@ -300,6 +328,13 @@ class Raylet:
         # live inbound transfers: deposit token -> _PullSink (chunk
         # frames route to their transfer by the token they carry)
         self._transfers: Dict[int, _PullSink] = {}
+        # broadcast tree: oid bytes -> the in-progress pull's sink, so
+        # this raylet can serve landed ranges ONWARD to child pullers
+        # (partial serve); plus fan-out observability counters
+        self._partial_serves: Dict[bytes, _PullSink] = {}
+        self._partial_chunks_out = 0
+        self._tree_pulls = 0
+        self._tree_position: Optional[int] = None
         # live actors hosted here: actor_id -> {"spec", "address"} — replayed
         # to a restarted GCS so its actor table survives (GCS FT)
         self.hosted_actors: Dict[bytes, Dict] = {}
@@ -1663,72 +1698,208 @@ class Raylet:
         a windowed multi-peer striped fetch. A failed attempt (peer died
         or timed out mid-pull) aborts the partial buffer ONCE and retries
         with fresh locations up to ``object_transfer_retries`` times.
+
+        Broadcast tree (``object_broadcast_fanout`` > 0): the pull first
+        registers with the GCS pull registry (``pull_begin``). When K
+        raylets pull the same large object concurrently, each is
+        assigned an earlier-arrived puller as its tree PARENT and
+        streams chunk ranges off the parent's in-progress pull (partial
+        serve) instead of the source — source egress stays O(fanout),
+        not O(K). A parent that dies, aborts, or never materializes is
+        excluded and the puller walks up to an ancestor or the source.
+
         Chaos-replay-deterministic: source-order shuffles draw from the
         seeded per-raylet RNG so a replayed fault schedule meets the
         same pull traffic (raylint R4 guards this)."""
         retries = max(1, int(GLOBAL_CONFIG.object_transfer_retries))
         stripe = max(1, int(GLOBAL_CONFIG.object_transfer_stripe_peers))
+        fanout = int(GLOBAL_CONFIG.object_broadcast_fanout)
+        min_tree = int(GLOBAL_CONFIG.object_broadcast_min_bytes)
         trace = os.environ.get("RAYTPU_TRANSFER_TRACE")
-        for attempt in range(retries):
-            t_loc = time.perf_counter()
-            if self.store.contains(oid):
-                return True
-            locs = await self.gcs.call_async(
-                "get_object_locations", oid_bytes
-            )
-            cands = []
-            for node_id in locs:
-                nid_hex = bytes(node_id).hex()
-                if nid_hex == self.node_id.hex():
+        bad_parents: List[bytes] = []  # tree parents that failed us
+        parent_misses: Dict[bytes, int] = {}  # parent -> no-meta probes
+        registered = False
+        try:
+            for attempt in range(retries):
+                t_loc = time.perf_counter()
+                if self.store.contains(oid):
+                    return True
+                parents: List[bytes] = []
+                if fanout > 0:
+                    try:
+                        info = await self.gcs.call_async(
+                            "pull_begin",
+                            [oid_bytes, self.node_id, bad_parents],
+                        )
+                        registered = True
+                        locs = info["locations"]
+                        parents = [bytes(p) for p in info["parents"]]
+                        self._tree_position = int(info.get("position", 0))
+                    except rpc.RpcError as e:
+                        if "unknown method" not in str(e):
+                            raise
+                        fanout = 0  # mixed-version GCS: no tree support
+                        locs = await self.gcs.call_async(
+                            "get_object_locations", oid_bytes
+                        )
+                else:
+                    locs = await self.gcs.call_async(
+                        "get_object_locations", oid_bytes
+                    )
+                cands = []
+                for node_id in locs:
+                    nid_hex = bytes(node_id).hex()
+                    if nid_hex == self.node_id.hex():
+                        continue
+                    node = self.cluster_nodes.get(nid_hex)
+                    if node is None or not node.get("alive", True):
+                        continue
+                    cands.append(node)
+                parent_nodes = []
+                for p in parents:
+                    node = self.cluster_nodes.get(p.hex())
+                    if node is not None and node.get("alive", True):
+                        parent_nodes.append((p, node))
+                if not cands and not parent_nodes:
+                    return False
+                # randomize the source order so an N-node broadcast forms a
+                # tree (each completed pull registers a new location) instead
+                # of every node hammering the origin (push_manager.h:30 role)
+                self._rng.shuffle(cands)
+                if GLOBAL_CONFIG.object_transfer_same_host_shm:
+                    for node in cands:
+                        if await self._pull_same_host_shm(oid, node):
+                            return True
+                addrs = [n["raylet_addr"] for n in cands]
+                paddrs = [n["raylet_addr"] for _, n in parent_nodes]
+                probe_n = min(len(addrs), max(stripe, 2))
+                t_meta = time.perf_counter()
+                metas = await asyncio.gather(
+                    *[self._peer_meta(a, oid)
+                      for a in addrs[:probe_n] + paddrs]
+                )
+                if trace:
+                    logger.info("pull %s: locations=%.3fs metas=%.3fs",
+                                oid.hex()[:12], t_meta - t_loc,
+                                time.perf_counter() - t_meta)
+                pmetas = metas[probe_n:]
+                sources = [
+                    (a, m)
+                    for a, m in zip(addrs, metas[:probe_n]) if m is not None
+                ]
+                # prefer in-memory copies over spill-restoring peers: stable
+                # sort keeps the shuffled tree order within each class
+                sources.sort(key=lambda am: bool(am[1].get("spilled")))
+                if not sources and not any(m for m in pmetas):
+                    for a in addrs[probe_n:]:
+                        m = await self._peer_meta(a, oid)
+                        if m is not None:
+                            sources = [(a, m)]
+                            break
+                psources = [
+                    (pid, a, m)
+                    for (pid, _), a, m in zip(parent_nodes, paddrs, pmetas)
+                    if m is not None
+                ]
+                sealed_size = (
+                    int(sources[0][1]["size"]) if sources else None
+                )
+                if parent_nodes and not psources and (
+                    sealed_size is None or sealed_size >= min_tree
+                ):
+                    # assigned parents haven't materialized their pulls
+                    # yet (they are probing their own sources right now):
+                    # re-probe on a short inner loop instead of hammering
+                    # the sealed source — this wait is what keeps source
+                    # egress O(fanout). Deeper tree levels ready later,
+                    # so the budget covers several cascade hops. (Objects
+                    # below the tree threshold skip the wait entirely.)
+                    # bounded retry-budget clock, not a replay-schedule
+                    # input (the fault schedule keys on frame seqs)
+                    wait_deadline = time.monotonic() + 1.0  # raylint: disable=R4 — budget clock
+                    while time.monotonic() < wait_deadline:  # raylint: disable=R4 — budget clock
+                        await asyncio.sleep(0.05)
+                        pmetas = await asyncio.gather(
+                            *[self._peer_meta(a, oid) for a in paddrs]
+                        )
+                        psources = [
+                            (pid, a, m) for (pid, _), a, m in zip(
+                                parent_nodes, paddrs, pmetas
+                            ) if m is not None
+                        ]
+                        if psources:
+                            break
+                    if not psources:
+                        for pid, _ in parent_nodes:
+                            parent_misses[pid] = (
+                                parent_misses.get(pid, 0) + 1
+                            )
+                            if parent_misses[pid] >= 2:
+                                # a full budget twice and still nothing
+                                # to stream from: stop waiting on it
+                                bad_parents.append(pid)
+                if not sources and not psources:
+                    # all candidates unreachable (dying peers / fault
+                    # window): back off before refreshing locations
+                    await asyncio.sleep(0.1 * (attempt + 1))
                     continue
-                node = self.cluster_nodes.get(nid_hex)
-                if node is None or not node.get("alive", True):
-                    continue
-                cands.append(node)
-            if not cands:
-                return False
-            # randomize the source order so an N-node broadcast forms a
-            # tree (each completed pull registers a new location) instead
-            # of every node hammering the origin (push_manager.h:30 role)
-            self._rng.shuffle(cands)
-            if GLOBAL_CONFIG.object_transfer_same_host_shm:
-                for node in cands:
-                    if await self._pull_same_host_shm(oid, node):
+                size = int(
+                    (psources[0][2] if psources else sources[0][1])["size"]
+                )
+                if psources and size >= min_tree:
+                    # ride the tree: stream off the assigned parent's
+                    # (possibly still in-progress) copy — the source NIC
+                    # is left to the tree roots
+                    self._tree_pulls += 1
+                    if await self._pull_striped(
+                        oid, size, [a for _, a, _ in psources[:stripe]]
+                    ):
                         return True
-            addrs = [n["raylet_addr"] for n in cands]
-            probe_n = min(len(addrs), max(stripe, 2))
-            t_meta = time.perf_counter()
-            metas = await asyncio.gather(
-                *[self._peer_meta(a, oid) for a in addrs[:probe_n]]
-            )
-            if trace:
-                logger.info("pull %s: locations=%.3fs metas=%.3fs",
-                            oid.hex()[:12], t_meta - t_loc,
-                            time.perf_counter() - t_meta)
-            sources = [
-                (a, m) for a, m in zip(addrs, metas) if m is not None
-            ]
-            # prefer in-memory copies over spill-restoring peers: stable
-            # sort keeps the shuffled tree order within each class
-            sources.sort(key=lambda am: bool(am[1].get("spilled")))
-            if not sources:
-                for a in addrs[probe_n:]:
-                    m = await self._peer_meta(a, oid)
-                    if m is not None:
-                        sources = [(a, m)]
-                        break
-            if not sources:
-                # all candidates unreachable (dying peers / fault window):
-                # back off before refreshing locations
-                await asyncio.sleep(0.1 * (attempt + 1))
-                continue
-            size = int(sources[0][1]["size"])
-            if await self._pull_striped(
-                oid, size, [a for a, _ in sources[:stripe]]
-            ):
-                return True
-            await asyncio.sleep(0.2 * (attempt + 1))
-        return False
+                    # the parent chain failed this attempt: exclude and
+                    # let pull_begin re-assign (ancestor or source)
+                    bad_parents.extend(pid for pid, _, _ in psources)
+                    await asyncio.sleep(0.2 * (attempt + 1))
+                    continue
+                live_parents = [
+                    pid for pid, _ in parent_nodes
+                    if pid not in bad_parents
+                ]
+                if (live_parents and not psources
+                        and (not sources or int(
+                            sources[0][1]["size"]
+                        ) >= min_tree)
+                        and attempt < retries - 1):
+                    # a parent is assigned but hasn't materialized its
+                    # pull yet (it is probing the source right now):
+                    # WAIT for it instead of hammering the source —
+                    # that wait is what keeps source egress O(fanout).
+                    # Two consecutive misses exclude the parent above,
+                    # and the last attempt always falls through.
+                    await asyncio.sleep(0.05 + 0.1 * attempt)
+                    continue
+                if psources and not sources and attempt < retries - 1:
+                    # small object assigned a parent that is still
+                    # pulling, and no sealed source is reachable: wait
+                    # for the parent to seal rather than failing
+                    await asyncio.sleep(0.1 * (attempt + 1))
+                    continue
+                if not sources:
+                    await asyncio.sleep(0.1 * (attempt + 1))
+                    continue
+                if await self._pull_striped(
+                    oid, size, [a for a, _ in sources[:stripe]]
+                ):
+                    return True
+                await asyncio.sleep(0.2 * (attempt + 1))
+            return False
+        finally:
+            if registered:
+                try:
+                    await self.gcs.call_async(
+                        "pull_end", [oid_bytes, self.node_id]
+                    )
+                except Exception:
+                    pass  # GCS restarting: registry prunes by liveness
 
     async def _pull_same_host_shm(self, oid, node: Dict) -> bool:
         """Same-host fast path: attach the peer raylet's store arena by
@@ -1840,7 +2011,8 @@ class Raylet:
         if buf is None:
             return self.store.contains(oid)
         t_create = time.perf_counter() - t_create
-        sink_target = _PullSink(buf)
+        chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
+        sink_target = _PullSink(buf, size=size, chunk=chunk)
         # Deposit sink: when the native engine carries this process's
         # peer connections, chunk payloads stream STRAIGHT off the
         # socket into `buf` (frames are tagged with this token) — the
@@ -1853,8 +2025,10 @@ class Raylet:
         if native_sink:
             _conduit.Engine.get().sink_register(token, buf)
         self._transfers[token] = sink_target
+        # broadcast tree: landed ranges of this in-progress pull are now
+        # servable onward to child pullers (read_object_chunks/meta)
+        self._partial_serves[oid.binary()] = sink_target
         del buf
-        chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
         ranges = _collections.deque(
             (off, min(chunk, size - off)) for off in range(0, size, chunk)
         )
@@ -2029,8 +2203,11 @@ class Raylet:
         except BaseException:
             # cancellation (raylet shutdown) or an unexpected fault must
             # not leak the registered sink (engine-pinned store buffer),
-            # the _transfers entry, or the unsealed partial buffer
+            # the _transfers entry, the partial-serve registration, or
+            # the unsealed partial buffer
             self._transfers.pop(token, None)
+            if self._partial_serves.get(oid.binary()) is sink_target:
+                self._partial_serves.pop(oid.binary(), None)
             if native_sink:
                 _conduit.Engine.get().sink_unregister(token)
             sink_target.close()
@@ -2060,6 +2237,10 @@ class Raylet:
             sink_target.close()
             self.store.seal(oid)
             self.store.release(oid)
+            # sealed: children switch from partial serve to the store
+            # path (the entry goes AFTER seal so they never see neither)
+            if self._partial_serves.get(oid.binary()) is sink_target:
+                self._partial_serves.pop(oid.binary(), None)
             dt = time.perf_counter() - t0
             if size > 0 and dt > 0:
                 self._last_pull_gbps = round(size / dt / 1e9, 3)
@@ -2082,6 +2263,8 @@ class Raylet:
         # failure: stop straggler writes, then abort the partial buffer
         # exactly once (this is the only abort site for this attempt)
         self._pull_aborts += 1
+        if self._partial_serves.get(oid.binary()) is sink_target:
+            self._partial_serves.pop(oid.binary(), None)
         sink_target.close()
         try:
             self.store.abort(oid)
@@ -2126,6 +2309,14 @@ class Raylet:
         if view is None and await self._restore_object(oid):
             view = self.store.get(oid, timeout=0)
         if view is None:
+            # broadcast tree: no sealed copy, but an IN-PROGRESS pull of
+            # this object can serve its landed ranges onward (the child
+            # rides behind this raylet's own transfer)
+            sink = self._partial_serves.get(bytes(oid_bytes))
+            if sink is not None and not sink.closed:
+                return await self._serve_chunks_partial(
+                    conn, oid, sink, req_ranges, token
+                )
             return None
         lock = threading.Lock()
         remaining = [1]  # the handler itself holds one ref
@@ -2199,6 +2390,89 @@ class Raylet:
             unref()
         return {"served": served}
 
+    async def _serve_chunks_partial(self, conn, oid, sink,
+                                    req_ranges, token) -> Optional[Dict]:
+        """Broadcast-tree partial serve: push requested ranges of an
+        in-progress pull as they LAND in the local sink's arrival
+        ledger. Each range waits (bounded by the chunk timeout) for
+        coverage; bytes are copied out under the sink lock — the child
+        pipelines behind this raylet's own transfer instead of hitting
+        the source. If the local pull seals mid-batch the remaining
+        ranges serve from the sealed store; if it aborts, the loop stops
+        and the child's batch check re-fetches elsewhere."""
+        timeout_s = float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s)
+        deadline = time.monotonic() + max(1.0, timeout_s * 0.9)
+        served = 0
+        for off, n in req_ranges:
+            off, n = int(off), int(n)
+            if off < 0 or n < 0 or off + n > sink.size:
+                break  # malformed range: stop serving the batch
+            payload: Optional[bytes] = None
+            while True:
+                if sink.covered(off, n):
+                    payload = sink.read(off, n)
+                    if payload is not None:
+                        break
+                if sink.closed:
+                    # sealed (serve from the store) or aborted (give up)
+                    payload = self._read_sealed_bytes(oid, off, n)
+                    break
+                if time.monotonic() > deadline:
+                    break
+                await asyncio.sleep(0.02)
+            if payload is None:
+                break
+            # pacing slot AFTER the wait: parked ranges must not occupy
+            # outbound capacity the sealed-serve path needs
+            await self._outbound_sem.acquire()
+            self._outbound_chunks += 1
+            self._transfer_bytes_out += n
+            self._partial_chunks_out += 1
+
+            def on_sent():
+                try:
+                    self._loop.call_soon_threadsafe(
+                        self._outbound_sem.release
+                    )
+                except RuntimeError:
+                    pass  # loop closed (raylet shutdown)
+
+            try:
+                conn.send_raw_frame(
+                    rpc._NOTIFY, None, "obj_chunk", [off, n], payload,
+                    on_sent=on_sent, token=int(token), off=off,
+                )
+            except Exception:
+                break  # conn died; on_sent already fired
+            served += 1
+            # asyncio fallback: drain past the high-water mark (see the
+            # sealed-serve path for why the semaphore alone is not pacing)
+            writer = getattr(conn, "writer", None)
+            if writer is not None and (
+                writer.transport.get_write_buffer_size()
+                > rpc._DRAIN_HIGH_WATER
+            ):
+                try:
+                    async with conn._write_lock:
+                        await writer.drain()
+                except Exception:
+                    break
+        return {"served": served}
+
+    def _read_sealed_bytes(self, oid, off: int, n: int) -> Optional[bytes]:
+        """One-shot copy of a sealed object's range (partial-serve's
+        seal-transition fallback; the pin is held only for the copy)."""
+        view = self.store.get(oid, timeout=0)
+        if view is None:
+            return None
+        try:
+            if off < 0 or n < 0 or off + n > view.nbytes:
+                return None
+            return bytes(view[off : off + n])
+        finally:
+            view.release()
+            self.store.release(oid)
+
     async def rpc_read_object_meta(self, conn, oid_bytes: bytes):
         """Size + spill state of a local copy. Does NOT force a restore:
         pullers use the ``spilled`` flag to prefer in-memory peers, and a
@@ -2216,6 +2490,12 @@ class Raylet:
         if entry is not None:
             self._objects_served += 1
             return {"size": entry[1], "spilled": True}
+        sink = self._partial_serves.get(bytes(oid_bytes))
+        if sink is not None and not sink.closed:
+            # broadcast tree: an in-progress pull is a valid source —
+            # children stream its landed ranges (partial serve)
+            self._objects_served += 1
+            return {"size": sink.size, "spilled": False, "partial": True}
         return None
 
     async def rpc_read_object_chunk_raw(self, conn, data):
@@ -2361,6 +2641,13 @@ class Raylet:
                 "pull_aborts": self._pull_aborts,
                 "chunk_retries": self._transfer_chunk_retries,
                 "peer_conns": self._peer_pool.stats(),
+                # broadcast tree: chunks this node relayed onward from
+                # in-progress pulls, pulls it rode through a tree parent,
+                # and its last assigned position in the pull registry
+                "partial_chunks_out": self._partial_chunks_out,
+                "tree_pulls": self._tree_pulls,
+                "tree_position": self._tree_position,
+                "partial_serves_open": len(self._partial_serves),
             },
         }
 

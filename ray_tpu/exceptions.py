@@ -31,6 +31,16 @@ class TaskError(RayTpuError):
         )
         return cls(function_name=function_name, traceback_str=tb, cause=exc)
 
+    def __reduce__(self):
+        # default Exception pickling reconstructs from ``args`` and would
+        # DROP ``cause`` — the typed original the serve handle path
+        # unwraps (BackpressureError & co. must survive the store round
+        # trip as objects, not as traceback text)
+        return (
+            type(self),
+            (self.function_name, self.traceback_str, self.cause),
+        )
+
     def __str__(self):
         return (
             f"Task failed in {self.function_name!r}. "
@@ -108,6 +118,53 @@ class NodeDiedError(RayTpuError):
 
 class PendingCallsLimitExceeded(RayTpuError):
     pass
+
+
+class BackpressureError(RayTpuError):
+    """Serve router admission rejected the request: every replica is at
+    its in-flight cap and the router's bounded queue is full (or the
+    queue wait timed out). Retryable by construction — the request was
+    NEVER dispatched to a replica. The HTTP ingress maps this to
+    503 + ``Retry-After``; the Python handle path raises it typed."""
+
+    retryable = True
+
+    def __init__(self, deployment: str = "", retry_after_s: float = 1.0,
+                 queue_depth: int = 0):
+        self.deployment = deployment
+        self.retry_after_s = float(retry_after_s)
+        self.queue_depth = int(queue_depth)
+        super().__init__(
+            f"deployment {deployment!r} is saturated "
+            f"(queue depth {queue_depth}); retry after "
+            f"{self.retry_after_s:.1f}s"
+        )
+
+    def __reduce__(self):
+        return (
+            type(self),
+            (self.deployment, self.retry_after_s, self.queue_depth),
+        )
+
+
+class ReplicaUnavailableError(RayTpuError):
+    """The replica serving an in-flight (already dispatched) request or
+    stream died mid-work. The request MAY have partially executed —
+    retry is safe for idempotent requests; streamed consumers decide
+    with the chunks they already received in hand."""
+
+    retryable = True
+
+    def __init__(self, deployment: str = "", detail: str = ""):
+        self.deployment = deployment
+        self.detail = detail
+        super().__init__(
+            f"replica of deployment {deployment!r} died mid-request"
+            + (f": {detail}" if detail else "")
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.deployment, self.detail))
 
 
 # Internal marker type stored in the object store in place of a value.

@@ -61,9 +61,22 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[Dict] = None,
                batch_max_size: Optional[int] = None,
                batch_wait_timeout_s: float = 0.01,
+               max_ongoing_requests: Optional[int] = None,
+               max_queued_requests: Optional[int] = None,
+               max_queue_wait_s: float = 10.0,
                ray_actor_options: Optional[Dict] = None,
                user_config: Optional[Dict] = None):
-    """Decorator: make a class or function deployable."""
+    """Decorator: make a class or function deployable.
+
+    ``max_ongoing_requests`` switches the deployment onto the SHARED
+    Router actor (``serve/router.py``): a hard per-replica in-flight cap
+    with power-of-two-choices admission over true deployment-wide queue
+    depths, a bounded admission queue (``max_queued_requests``, default
+    2x total capacity; waiters give up after ``max_queue_wait_s``), and
+    typed ``BackpressureError`` rejection beyond it (HTTP ingress: 503 +
+    Retry-After). ``autoscaling_config`` may additionally carry
+    ``ttft_slo_ms`` / ``upscale_delay_s`` / ``downscale_delay_s`` /
+    ``provision_hook`` for SLO-driven replica scaling."""
 
     def wrap(obj):
         ctor = obj
@@ -86,6 +99,9 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 "autoscaling_config": autoscaling_config,
                 "batch_max_size": batch_max_size,
                 "batch_wait_timeout_s": batch_wait_timeout_s,
+                "max_ongoing_requests": max_ongoing_requests,
+                "max_queued_requests": max_queued_requests,
+                "max_queue_wait_s": max_queue_wait_s,
                 "ray_actor_options": ray_actor_options or {},
                 "user_config": user_config,
             },
@@ -192,6 +208,10 @@ def start_http_proxy(port: int = 0) -> str:
     return ray_tpu.get(_proxy.address.remote(), timeout=60)
 
 
+from ray_tpu.exceptions import (  # noqa: F401,E402 — serve-level re-export
+    BackpressureError,
+    ReplicaUnavailableError,
+)
 from ray_tpu.serve.multiplex import (  # noqa: F401,E402
     get_multiplexed_model_id,
     multiplexed,
@@ -211,4 +231,5 @@ __all__ = [
     "deployment", "run", "delete", "status", "get_deployment_handle",
     "start_http_proxy", "Deployment", "Application", "DeploymentHandle",
     "LLMEngine", "LLMServer", "multiplexed", "get_multiplexed_model_id",
+    "BackpressureError", "ReplicaUnavailableError",
 ]

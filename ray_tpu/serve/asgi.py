@@ -232,6 +232,7 @@ def _reason(status: int) -> str:
     return {
         200: "OK", 404: "Not Found", 500: "Internal Server Error",
         400: "Bad Request", 405: "Method Not Allowed",
+        503: "Service Unavailable",
     }.get(status, "OK")
 
 
@@ -273,6 +274,8 @@ class ServeIngress:
                 send, 404, {"error": f"no deployment {name!r}"}
             )
             return
+        from ray_tpu.exceptions import BackpressureError
+
         loop = asyncio.get_running_loop()
         if not streaming:
             try:
@@ -284,6 +287,16 @@ class ServeIngress:
                 )
             except KeyError as e:  # unknown deployment (router-side)
                 await _json_response(send, 404, {"error": str(e)})
+                return
+            except BackpressureError as e:
+                # router admission rejected: the canonical overload reply
+                # — 503 + Retry-After, never an opaque 500
+                await _json_response(
+                    send, 503,
+                    {"error": str(e), "retry_after_s": e.retry_after_s},
+                    headers=[(b"retry-after",
+                              str(max(1, int(e.retry_after_s))).encode())],
+                )
                 return
             except Exception as e:  # noqa: BLE001 — surfaced to client
                 await _json_response(send, 500, {"error": str(e)})
@@ -336,6 +349,11 @@ class ServeIngress:
                 for item in it:
                     if not _pump_put({"chunk": item}):
                         return
+            except BackpressureError as e:
+                # admission rejection happens BEFORE the first chunk, so
+                # the consumer can still answer 503 + Retry-After
+                _pump_put({"reject": str(e),
+                           "retry_after_s": e.retry_after_s})
             except Exception as e:  # noqa: BLE001 — surfaced in-band
                 _pump_put({"error": str(e)})
             finally:
@@ -349,13 +367,29 @@ class ServeIngress:
 
         loop.run_in_executor(None, pump)
         try:
+            # the response STATUS waits for the first pump item: a
+            # rejected/failed stream answers 503/500 JSON instead of a
+            # 200 whose error hides in a chunk
+            first = await q.get()
+            if isinstance(first, dict) and "reject" in first:
+                ra = float(first.get("retry_after_s") or 1.0)
+                await _json_response(
+                    send, 503,
+                    {"error": first["reject"], "retry_after_s": ra},
+                    headers=[(b"retry-after",
+                              str(max(1, int(ra))).encode())],
+                )
+                return
+            if isinstance(first, dict) and "error" in first:
+                await _json_response(send, 500, first)
+                return
             await send({
                 "type": "http.response.start",
                 "status": 200,
                 "headers": [(b"content-type", b"application/jsonl")],
             })
+            item = first
             while True:
-                item = await q.get()
                 if item is _DONE:
                     break
                 await send({
@@ -363,6 +397,7 @@ class ServeIngress:
                     "body": json.dumps(item).encode() + b"\n",
                     "more_body": True,
                 })
+                item = await q.get()
             await send({"type": "http.response.body", "body": b"",
                         "more_body": False})
         finally:
@@ -371,7 +406,7 @@ class ServeIngress:
                 q.get_nowait()
 
 
-async def _json_response(send, status: int, obj) -> None:
+async def _json_response(send, status: int, obj, headers=None) -> None:
     out = json.dumps(obj).encode()
     await send({
         "type": "http.response.start",
@@ -379,7 +414,7 @@ async def _json_response(send, status: int, obj) -> None:
         "headers": [
             (b"content-type", b"application/json"),
             (b"content-length", str(len(out)).encode()),
-        ],
+        ] + list(headers or []),
     })
     await send({"type": "http.response.body", "body": out,
                 "more_body": False})

@@ -6,7 +6,12 @@ deployment_state.py:1097,2130) and ``replica.py:447``. The controller is a
 detached named actor; each replica actor wraps the user's callable. Request
 autoscaling follows the reference BasicAutoscalingPolicy shape
 (autoscaling_policy.py:95): desired = ceil(total ongoing / target per
-replica), clamped to [min, max], driven by router-reported load.
+replica), clamped to [min, max], driven by router-reported load — PLUS an
+SLO layer: deployments fronted by the shared Router actor report TTFT
+percentiles and admission-queue depth, and the controller scales up on
+sustained SLO burn (p95 TTFT over ``ttft_slo_ms`` or a standing queue)
+and down on sustained idle, optionally filing queued-resource requests
+through a pluggable provision hook on each scale-up.
 """
 
 from __future__ import annotations
@@ -18,6 +23,33 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class QueuedResourceProvisioner:
+    """Picklable scale-up hook: files one queued-resource request per
+    added replica through a ``TpuApiClient``-compatible provider (the
+    ``cloud_rest.RestTpuApi`` speaks the real API; ``MockTpuApi`` serves
+    tests). Pass as ``autoscaling_config["provision_hook"]``. The client
+    is built lazily per call so the hook stays picklable."""
+
+    def __init__(self, client_factory, accelerator_type: str,
+                 runtime_version: str, name_prefix: str = "serve",
+                 spot: bool = False):
+        self.client_factory = client_factory
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.name_prefix = name_prefix
+        self.spot = spot
+
+    def __call__(self, deployment: str, old_n: int, new_n: int):
+        client = self.client_factory()
+        for i in range(int(old_n), int(new_n)):
+            client.create_queued_resource(
+                f"{self.name_prefix}-{deployment.lower()}-{i}",
+                accelerator_type=self.accelerator_type,
+                runtime_version=self.runtime_version,
+                spot=self.spot,
+            )
 
 
 class Replica:
@@ -77,10 +109,16 @@ class ServeController:
     def __init__(self):
         import threading
 
-        # name -> {"spec": {...}, "replicas": [handle], "version": int}
+        # name -> {"spec": {...}, "replicas": [handle], "version": int,
+        #          "router": handle|None}
         self.deployments: Dict[str, Dict[str, Any]] = {}
         # router-reported ongoing-request counts: (deployment, router_id)
         self._load: Dict[str, Dict[str, Any]] = {}
+        # shared-router metric reports: deployment -> router_id -> (m, ts)
+        self._router_metrics: Dict[str, Dict[str, Any]] = {}
+        # SLO autoscaling state: deployment -> {"burn_since", "idle_since",
+        # "last_scale"} (monotonic timestamps or None)
+        self._slo_state: Dict[str, Dict[str, Optional[float]]] = {}
         # replicas pulled from rotation but still finishing in-flight work:
         # [handle, pulled_at_ts, sentinel_ref_or_None] — killed once the
         # sentinel confirms the drain (background reaper below; an
@@ -151,6 +189,14 @@ class ServeController:
 
     def deploy(self, name: str, constructor, init_args, init_kwargs,
                config: Dict[str, Any]):
+        if config.get("max_ongoing_requests") and config.get(
+            "batch_max_size"
+        ):
+            raise ValueError(
+                "max_ongoing_requests (shared-router admission) and "
+                "batch_max_size (handle-side batching) are mutually "
+                "exclusive on one deployment"
+            )
         existing = self.deployments.get(name)
         version = (existing["version"] + 1) if existing else 1
         dep = {
@@ -162,14 +208,58 @@ class ServeController:
             },
             "replicas": [],
             "version": version,
+            "router": existing.get("router") if existing else None,
         }
         old = existing["replicas"] if existing else []
         self.deployments[name] = dep
         self._scale_to(name, self._initial_target(config))
         for r in old:  # tear down the previous version's replicas
             self._stop_replica(r)
+        if config.get("max_ongoing_requests"):
+            self._ensure_router(name)
         return {"name": name, "version": version,
                 "num_replicas": len(dep["replicas"])}
+
+    def _ensure_router(self, name: str):
+        """Start (or adopt) the deployment's shared Router actor. Named,
+        so a controller restart re-binds to the live router instead of
+        racing a second one into existence."""
+        from ray_tpu.serve.router import (
+            RouterActor,
+            router_actor_name,
+            router_concurrency,
+        )
+
+        dep = self.deployments[name]
+        if dep.get("router") is not None:
+            return dep["router"]
+        rname = router_actor_name(name)
+        try:
+            dep["router"] = ray_tpu.get_actor(rname)
+            return dep["router"]
+        except Exception:
+            pass
+        cls = ray_tpu.remote(
+            num_cpus=0.05, name=rname,
+            max_concurrency=router_concurrency(dep["spec"]["config"]),
+        )(RouterActor)
+        try:
+            dep["router"] = cls.remote(
+                ray_tpu.get_actor(CONTROLLER_NAME), name
+            )
+        except Exception:
+            dep["router"] = ray_tpu.get_actor(rname)  # lost a race
+        return dep["router"]
+
+    def get_router(self, name: str):
+        """Handle discovery: the shared router fronting this deployment
+        (None = per-handle routing, no admission control configured)."""
+        dep = self.deployments.get(name)
+        if dep is None:
+            return None
+        if not dep["spec"]["config"].get("max_ongoing_requests"):
+            return None
+        return self._ensure_router(name)
 
     def _initial_target(self, config) -> int:
         auto = config.get("autoscaling_config")
@@ -183,6 +273,12 @@ class ServeController:
         # pass the user's actor options straight through (num_cpus/num_tpus/
         # resources/... — ray_tpu.remote understands them all)
         opts = dict(spec["config"].get("ray_actor_options") or {})
+        cap = spec["config"].get("max_ongoing_requests")
+        if cap and "max_concurrency" not in opts:
+            # the router admits up to ``cap`` concurrent requests per
+            # replica; the replica must actually run them concurrently
+            # (+2: drain sentinel / health traffic never queue behind work)
+            opts["max_concurrency"] = int(cap) + 2
         cls = ray_tpu.remote(**opts)(Replica)
         return cls.remote(
             spec["constructor"], spec["init_args"], spec["init_kwargs"]
@@ -229,6 +325,12 @@ class ServeController:
             dep["replicas"] = alive
             self._scale_to(name, len(alive) + replaced)
             dep["version"] += 1  # force router refresh onto the new set
+        router = dep.get("router")
+        if router is not None and state_of.get(
+            getattr(router, "_actor_id", None)
+        ) == "DEAD":
+            dep["router"] = None  # next get_router restarts it
+            self._ensure_router(name)
         return replaced
 
     _last_check = 0.0
@@ -273,6 +375,13 @@ class ServeController:
             return False
         for r in dep["replicas"]:
             self._stop_replica(r)
+        if dep.get("router") is not None:
+            try:
+                ray_tpu.kill(dep["router"])
+            except Exception:
+                pass
+        self._router_metrics.pop(name, None)
+        self._slo_state.pop(name, None)
         return True
 
     # -- autoscaling --
@@ -290,6 +399,65 @@ class ServeController:
             del per[rid]
         return self.autoscale_once(deployment)
 
+    def report_router_metrics(self, deployment: str, router_id: str,
+                              m: Dict[str, Any]):
+        """Shared Router actors push their metric snapshot ~1/s: TTFT
+        percentiles, admission-queue depth, in-flight counts, rejection
+        totals. This is the autoscaling SIGNAL PATH for SLO-driven
+        deployments — load-only reporting can't see a latency SLO burn
+        that happens under a full in-flight window."""
+        self._reap_draining()
+        per = self._router_metrics.setdefault(deployment, {})
+        per[router_id] = (dict(m), time.monotonic())
+        # feed the ongoing-based policy too (shared-router deployments
+        # have no per-handle load reporters)
+        self._load.setdefault(deployment, {})[router_id] = (
+            int(m.get("ongoing", 0)) + int(m.get("queued", 0)),
+            time.time(),
+        )
+        return self.autoscale_once(deployment)
+
+    #: router reports older than this are ignored by BOTH the SLO policy
+    #: and the observability aggregate (one staleness horizon)
+    ROUTER_REPORT_FRESH_S = 10.0
+
+    def _fresh_router_reports(self, name: str) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        return [
+            m for m, ts in self._router_metrics.get(name, {}).values()
+            if now - ts < self.ROUTER_REPORT_FRESH_S
+        ]
+
+    @staticmethod
+    def _reports_p95(reports: List[Dict[str, Any]]) -> float:
+        """Worst router-reported TTFT p95 among routers with samples."""
+        return max(
+            (m.get("ttft_p95_ms", 0.0) for m in reports
+             if m.get("ttft_n", 0) > 0), default=0.0,
+        )
+
+    def deployment_metrics(self, name: str) -> Dict[str, Any]:
+        """Aggregated latest router metrics (observability/bench)."""
+        reports = self._fresh_router_reports(name)
+        dep = self.deployments.get(name)
+        out: Dict[str, Any] = {
+            "num_replicas": len(dep["replicas"]) if dep else 0,
+            "routers": len(reports),
+        }
+        if reports:
+            out.update({
+                "ongoing": sum(m.get("ongoing", 0) for m in reports),
+                "queued": sum(m.get("queued", 0) for m in reports),
+                "rejected_total": sum(
+                    m.get("rejected_total", 0) for m in reports
+                ),
+                "routed_total": sum(
+                    m.get("routed_total", 0) for m in reports
+                ),
+                "ttft_p95_ms": self._reports_p95(reports),
+            })
+        return out
+
     def autoscale_once(self, name: str) -> Optional[int]:
         dep = self.deployments.get(name)
         if dep is None:
@@ -306,9 +474,92 @@ class ServeController:
         desired = math.ceil(total / max(target, 1e-9)) if total else 0
         desired = max(int(auto.get("min_replicas", 1)),
                       min(int(auto.get("max_replicas", 1)), desired))
-        if desired != len(dep["replicas"]):
-            self._scale_to(name, desired)
+        if auto.get("ttft_slo_ms") is not None:
+            # SLO deployments: the ongoing-based desired only RAISES the
+            # replica count (immediate reaction to demand); shrinking is
+            # owned by the sustained-idle policy below, so a momentary
+            # ongoing dip can't undo an SLO-burn scale-up.
+            if desired > len(dep["replicas"]):
+                self._autoscale_to(name, desired)
+            self._autoscale_slo(name)
+        elif desired != len(dep["replicas"]):
+            self._autoscale_to(name, desired)
         return len(dep["replicas"])
+
+    def _autoscale_to(self, name: str, n: int):
+        """Autoscaler-driven resize: on scale-UP, first fire the optional
+        provision hook (queued-resources capacity request) — replica
+        actors beyond current cluster capacity then schedule as the
+        provisioned nodes join."""
+        dep = self.deployments[name]
+        cur = len(dep["replicas"])
+        if n > cur:
+            hook = (dep["spec"]["config"].get("autoscaling_config")
+                    or {}).get("provision_hook")
+            if hook is not None:
+                try:
+                    hook(name, cur, n)
+                except Exception:
+                    pass  # capacity request failures must not stall serve
+        self._scale_to(name, n)
+
+    def _autoscale_slo(self, name: str):
+        """SLO layer: scale up on sustained TTFT-SLO burn or a standing
+        admission queue; scale down one replica at a time on sustained
+        idle. Both directions are debounced (upscale_delay_s /
+        downscale_delay_s) so one hot poll can't flap the replica set."""
+        dep = self.deployments[name]
+        auto = dep["spec"]["config"].get("autoscaling_config") or {}
+        now = time.monotonic()
+        reports = self._fresh_router_reports(name)
+        if not reports:
+            return
+        slo = auto.get("ttft_slo_ms")
+        p95 = self._reports_p95(reports)
+        queued = sum(m.get("queued", 0) for m in reports)
+        ongoing = sum(m.get("ongoing", 0) for m in reports)
+        st = self._slo_state.setdefault(
+            name, {"burn_since": None, "idle_since": None, "last_scale": 0.0}
+        )
+        n = len(dep["replicas"])
+        mn = int(auto.get("min_replicas", 1))
+        mx = int(auto.get("max_replicas", 1))
+        up_delay = float(auto.get("upscale_delay_s", 2.0))
+        down_delay = float(auto.get("downscale_delay_s", 30.0))
+        target = float(auto.get("target_ongoing_requests", 1.0))
+        # a p95 burn only counts while there IS load: stale samples from
+        # a finished burst must not pin replicas against the idle policy
+        burn = queued > 0 or (
+            slo is not None and p95 > float(slo) and ongoing + queued > 0
+        )
+        if burn and n < mx:
+            if st["burn_since"] is None:
+                st["burn_since"] = now
+            elif (now - st["burn_since"] >= up_delay
+                  and now - st["last_scale"] >= up_delay):
+                add = max(1, math.ceil(queued / max(target, 1.0)))
+                self._autoscale_to(name, min(mx, n + add))
+                st["last_scale"] = now
+                st["burn_since"] = None
+        elif not burn:
+            st["burn_since"] = None
+        # sustained idle: the deployment comfortably fits one fewer replica
+        cap_per = float(
+            dep["spec"]["config"].get("max_ongoing_requests") or target
+        )
+        idle = (
+            not burn and n > mn
+            and ongoing + queued <= 0.5 * cap_per * (n - 1)
+        )
+        if idle:
+            if st["idle_since"] is None:
+                st["idle_since"] = now
+            elif now - st["idle_since"] >= down_delay:
+                self._autoscale_to(name, n - 1)
+                st["idle_since"] = None
+                st["last_scale"] = now
+        else:
+            st["idle_since"] = None
 
     def health(self):
         return "ok"

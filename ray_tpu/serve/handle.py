@@ -4,7 +4,17 @@ Parity: reference ``python/ray/serve/handle.py:86`` → ``_private/router.py
 :856`` (power-of-two-choices replica scheduler) and ``batching.py``
 (@serve.batch). TPU twist: batching lives in the ROUTER — queued requests
 are grouped into one replica call so a TPU replica sees step-sized batches
-(continuous batching at the ingress, not per-replica asyncio)."""
+(continuous batching at the ingress, not per-replica asyncio).
+
+Two routing modes per deployment:
+
+- default: the in-process ``Router`` below (one per handle — cheap, no
+  extra hop, in-flight view local to this client);
+- ``max_ongoing_requests`` set: every handle routes through the
+  deployment's ONE shared Router actor (``serve/router.py``) — true
+  deployment-wide queue depths, hard per-replica caps, bounded-queue
+  admission with typed ``BackpressureError`` rejection, and the TTFT
+  signal the SLO autoscaler consumes."""
 
 from __future__ import annotations
 
@@ -15,6 +25,20 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    BackpressureError,
+    ReplicaUnavailableError,
+    TaskError,
+)
+
+
+def _unwrap_typed(e: BaseException) -> Optional[BaseException]:
+    """A typed serve error raised inside the router/replica actor arrives
+    wrapped in TaskError; hand the caller the original, typed."""
+    cause = getattr(e, "cause", None)
+    if isinstance(cause, (BackpressureError, ReplicaUnavailableError)):
+        return cause
+    return None
 
 
 class _PendingRequest:
@@ -382,17 +406,185 @@ class _LocalFuture:
         return self._req.result
 
 
+class _RoutedFuture:
+    """Future for a request dispatched through the shared Router actor.
+    Unwraps typed serve errors (BackpressureError stays typed on the
+    Python handle path); one transparent resubmit if the ROUTER actor
+    itself died (the controller restarts it)."""
+
+    def __init__(self, ref, resubmit=None):
+        self._ref = ref
+        self._resubmit = resubmit
+
+    def result(self, timeout: Optional[float] = 120.0):
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+        )
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except TaskError as e:
+            typed = _unwrap_typed(e)
+            if typed is not None:
+                raise typed from None
+            raise
+        except (ActorDiedError, ActorUnavailableError):
+            if self._resubmit is None:
+                raise
+            resubmit, self._resubmit = self._resubmit, None
+            self._ref = resubmit()
+            return self.result(timeout=timeout)
+
+
+class _RoutedStreamIterator:
+    """Client side of a router-pass-through stream: yields chunk VALUES,
+    unwrapping typed serve errors. Closing cancels the router's
+    generator, which closes the replica stream behind it. If the ROUTER
+    actor itself died, ``on_router_dead`` runs (drops the client's
+    cached handle, so the next call lands on the restarted router)
+    before the error propagates."""
+
+    def __init__(self, gen, on_router_dead=None):
+        self._gen = gen
+        self._on_router_dead = on_router_dead
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def _note_router_death(self):
+        cb, self._on_router_dead = self._on_router_dead, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def __next__(self):
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+        )
+
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._done = True
+            raise
+        except TaskError as e:
+            # stream finalized with the router's error (e.g. admission
+            # rejected before the first chunk): surface it typed
+            typed = _unwrap_typed(e)
+            if typed is not None:
+                raise typed from None
+            raise
+        except (ActorDiedError, ActorUnavailableError):
+            self._note_router_death()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except TaskError as e:
+            typed = _unwrap_typed(e)
+            if typed is not None:
+                raise typed from None
+            raise
+        except (ActorDiedError, ActorUnavailableError):
+            self._note_router_death()
+            raise
+
+    def close(self):
+        if not self._done:
+            self._done = True
+            self._gen.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _SharedRouterClient:
+    """Handle-side stub for the per-deployment shared Router actor."""
+
+    def __init__(self, controller, deployment: str, router):
+        self._controller = controller
+        self.deployment = deployment
+        self._router_handle = router
+
+    def _router(self):
+        if self._router_handle is None:
+            self._router_handle = ray_tpu.get(
+                self._controller.get_router.remote(self.deployment),
+                timeout=60,
+            )
+            if self._router_handle is None:
+                raise KeyError(f"no deployment {self.deployment!r}")
+        return self._router_handle
+
+    def _refetch_and_route(self, args, kwargs):
+        # router actor died: the controller's reconcile restarts it
+        try:
+            ray_tpu.get(
+                self._controller.check_replicas.remote(self.deployment),
+                timeout=60,
+            )
+        except Exception:
+            pass
+        self._router_handle = None
+        return self._router().route.remote(
+            list(args), dict(kwargs or {})
+        )
+
+    def submit(self, args, kwargs):
+        ref = self._router().route.remote(list(args), dict(kwargs or {}))
+        return _RoutedFuture(
+            ref, resubmit=lambda: self._refetch_and_route(args, kwargs)
+        )
+
+    def submit_stream(self, args, kwargs):
+        gen = self._router().route_stream.options(
+            num_returns="streaming"
+        ).remote(list(args), dict(kwargs or {}))
+
+        def on_router_dead():
+            # the router actor (not a replica) died: drop the cached
+            # handle and nudge the controller's reconcile — the NEXT
+            # call refetches the restarted router
+            self._router_handle = None
+            try:
+                self._controller.check_replicas.remote(self.deployment)
+            except Exception:
+                pass
+
+        return _RoutedStreamIterator(gen, on_router_dead=on_router_dead)
+
+
 class DeploymentHandle:
     """Picklable client handle (parity: serve.get_deployment_handle)."""
 
     def __init__(self, controller, deployment: str):
         self._controller = controller
         self._deployment = deployment
-        self._router: Optional[Router] = None
+        self._router: Optional[Any] = None
 
-    def _get_router(self) -> Router:
+    def _get_router(self):
         if self._router is None:
-            self._router = Router(self._controller, self._deployment)
+            shared = None
+            try:
+                shared = ray_tpu.get(
+                    self._controller.get_router.remote(self._deployment),
+                    timeout=30,
+                )
+            except Exception:
+                shared = None  # older controller / degraded: local mode
+            if shared is not None:
+                self._router = _SharedRouterClient(
+                    self._controller, self._deployment, shared
+                )
+            else:
+                self._router = Router(self._controller, self._deployment)
         return self._router
 
     def remote(self, *args, **kwargs):

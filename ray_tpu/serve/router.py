@@ -1,0 +1,402 @@
+"""Shared Router actor: load-aware admission in front of N replicas.
+
+Parity: reference ``python/ray/serve/_private/router.py:856`` (the
+power-of-two-choices replica scheduler) plus the pieces the reference
+spreads across Router/ReplicaScheduler/ReplicaWrapper: a HARD per-replica
+in-flight cap (``max_ongoing_requests``), a BOUNDED admission queue with
+typed rejection (``BackpressureError`` — reject, don't buffer
+unboundedly), and streaming pass-through (proxy -> router -> replica on
+the caller-owned streaming generator protocol).
+
+Unlike the per-handle router in ``handle.py`` (each driver process keeps
+its own in-flight view), this is ONE actor per deployment: every client
+routes through it, so the in-flight counts it balances on are the true
+per-replica queue depths, and the TTFT/queue-depth series it reports is
+the deployment-wide signal the controller's SLO autoscaler consumes.
+
+Replay note: replica picks draw from ``chaos.replay_rng`` (raylint R4 —
+this module is in R4 scope), so a seeded chaos schedule meets the same
+routing decisions.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu._private import chaos as _chaos
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    BackpressureError,
+    ReplicaUnavailableError,
+)
+
+ROUTER_NAME_PREFIX = "SERVE_ROUTER:"
+
+
+def router_actor_name(deployment: str) -> str:
+    return ROUTER_NAME_PREFIX + deployment
+
+
+def router_concurrency(config: Dict[str, Any]) -> int:
+    """max_concurrency for the router actor: enough threads for every
+    admitted request + every queued waiter + control traffic."""
+    cap = int(config.get("max_ongoing_requests") or 8)
+    auto = config.get("autoscaling_config") or {}
+    replicas = int(auto.get("max_replicas")
+                   or config.get("num_replicas") or 1)
+    queued = config.get("max_queued_requests")
+    queued = int(queued) if queued is not None else 2 * cap * replicas
+    return max(16, cap * replicas + queued + 8)
+
+
+class _TtftWindow:
+    """Sliding window of time-to-first-token samples (ms)."""
+
+    def __init__(self, horizon_s: float = 30.0, cap: int = 512):
+        self.horizon_s = horizon_s
+        self._samples: "collections.deque" = collections.deque(maxlen=cap)
+        self._lock = threading.Lock()  # recorders race the percentile scan
+
+    def record(self, ms: float, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((now, float(ms)))
+
+    def percentiles(self, now: Optional[float] = None) -> Dict[str, float]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            snap = list(self._samples)
+        vals = sorted(
+            ms for ts, ms in snap if now - ts <= self.horizon_s
+        )
+        if not vals:
+            return {"n": 0, "p50": 0.0, "p95": 0.0}
+        return {
+            "n": len(vals),
+            "p50": vals[len(vals) // 2],
+            "p95": vals[min(len(vals) - 1, int(len(vals) * 0.95))],
+        }
+
+
+class RouterActor:
+    """Actor body: admission + routing for ONE deployment.
+
+    Request lifecycle::
+
+        admit (p2c over tracked per-replica in-flight, hard cap C)
+          | every replica at C -> wait on the bounded queue
+          |   queue full / wait timed out -> BackpressureError (typed)
+          v
+        dispatch to the picked replica
+          streaming: pass chunks through as the replica yields them
+          replica died mid-flight:
+            plain call  -> ONE transparent re-admission to a survivor
+            stream      -> ReplicaUnavailableError (typed, retryable)
+          v
+        release the slot (always; a died replica's slots are dropped
+        with it, so capacity never leaks)
+    """
+
+    REFRESH_S = 1.0
+    # a death-marked replica re-enters the pick set after this grace:
+    # a TRANSIENT ActorUnavailableError (network blip) must not remove
+    # a live replica's capacity forever — a truly dead one just fails
+    # its next probe request and re-marks until the controller replaces
+    # it (the plain path retries that probe transparently)
+    DEAD_GRACE_S = 5.0
+
+    def __init__(self, controller, deployment: str):
+        self._controller = controller
+        self.deployment = deployment
+        self.router_id = "shared:" + deployment
+        self._rng = _chaos.replay_rng("serve-router|" + deployment)
+        self._cond = threading.Condition()
+        # replica set (refreshed from the controller by version)
+        self._replicas: List[Tuple[bytes, Any]] = []  # (actor_id, handle)
+        self._version = -1
+        self._config: Dict[str, Any] = {}
+        # actor id -> mark time; awaiting controller reconcile, expiring
+        # after DEAD_GRACE_S (transient unavailability self-heals)
+        self._dead: Dict[bytes, float] = {}
+        # admission state
+        self._inflight: Dict[bytes, int] = {}  # actor_id -> ongoing
+        self._queued = 0
+        self._rejected = 0
+        self._routed = 0
+        self._reroutes = 0
+        self._streams_active = 0
+        self._ttft = _TtftWindow()
+        self._stop = False
+        self._refresh(force=True)
+        threading.Thread(target=self._refresh_loop, daemon=True,
+                         name=f"router-refresh-{deployment}").start()
+
+    # ---------------- replica set ----------------
+
+    def _refresh(self, force: bool = False):
+        info = ray_tpu.get(
+            self._controller.get_replicas.remote(self.deployment),
+            timeout=30,
+        )
+        if info is None:
+            raise KeyError(f"no deployment {self.deployment!r}")
+        ids = [getattr(r, "_actor_id", None) for r in info["replicas"]]
+        with self._cond:
+            if not force and info["version"] == self._version and (
+                ids == [aid for aid, _ in self._replicas]
+            ):
+                return
+            self._version = info["version"]
+            self._config = info["config"]
+            self._replicas = list(zip(ids, info["replicas"]))
+            live = set(ids)
+            # replaced replicas leave the dead set; survivors keep their
+            # mark until it expires (see DEAD_GRACE_S)
+            self._dead = {
+                aid: ts for aid, ts in self._dead.items() if aid in live
+            }
+            # carry in-flight counts for surviving replicas; a removed
+            # replica's slots vanish with it
+            self._inflight = {
+                aid: self._inflight.get(aid, 0) for aid in live
+            }
+            self._cond.notify_all()
+
+    def _refresh_loop(self):
+        while not self._stop:
+            time.sleep(self.REFRESH_S)
+            try:
+                self._refresh()
+                self._report_metrics()
+            except Exception:
+                # controller briefly unreachable (restart window) or the
+                # cluster is coming down: keep serving the cached set
+                continue
+
+    def _report_metrics(self):
+        m = self.metrics()
+        try:
+            self._controller.report_router_metrics.remote(
+                self.deployment, self.router_id, m
+            )
+        except Exception:
+            pass
+
+    # ---------------- admission ----------------
+
+    def _cap(self) -> int:
+        return max(1, int(self._config.get("max_ongoing_requests") or 1))
+
+    def _queue_limit(self) -> int:
+        q = self._config.get("max_queued_requests")
+        if q is not None:
+            return max(0, int(q))
+        return max(8, 2 * self._cap() * max(1, len(self._replicas)))
+
+    def _pickable(self) -> List[Tuple[bytes, Any]]:
+        cap = self._cap()
+        now = time.monotonic()
+        for aid in [a for a, ts in self._dead.items()
+                    if now - ts > self.DEAD_GRACE_S]:
+            del self._dead[aid]  # grace over: probe it again
+        return [
+            (aid, h) for aid, h in self._replicas
+            if aid not in self._dead and self._inflight.get(aid, 0) < cap
+        ]
+
+    def _admit(self) -> Tuple[bytes, Any]:
+        """Block until a replica slot frees (bounded), or reject typed.
+        Power-of-two-choices over the router-tracked in-flight counts."""
+        deadline = time.monotonic() + float(
+            self._config.get("max_queue_wait_s") or 10.0
+        )
+        with self._cond:
+            while True:
+                cand = self._pickable()
+                if cand:
+                    if len(cand) == 1:
+                        aid, handle = cand[0]
+                    else:
+                        a, b = self._rng.sample(range(len(cand)), 2)
+                        ia, ib = cand[a], cand[b]
+                        aid, handle = (
+                            ia if self._inflight.get(ia[0], 0)
+                            <= self._inflight.get(ib[0], 0) else ib
+                        )
+                    self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                    self._routed += 1
+                    return aid, handle
+                if self._queued >= self._queue_limit():
+                    self._rejected += 1
+                    raise BackpressureError(
+                        self.deployment,
+                        retry_after_s=1.0,
+                        queue_depth=self._queued,
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._rejected += 1
+                    raise BackpressureError(
+                        self.deployment,
+                        retry_after_s=1.0,
+                        queue_depth=self._queued,
+                    )
+                self._queued += 1
+                try:
+                    self._cond.wait(timeout=min(remaining, 0.5))
+                finally:
+                    self._queued -= 1
+
+    def _release(self, aid: bytes):
+        with self._cond:
+            if aid in self._inflight:
+                self._inflight[aid] = max(0, self._inflight[aid] - 1)
+            self._cond.notify_all()
+
+    def _mark_dead(self, aid: bytes):
+        """A call against this replica saw actor death: pull it from the
+        pick set NOW (its queued capacity moves to survivors) and nudge
+        the controller to reconcile/replace it."""
+        with self._cond:
+            if aid in self._dead:
+                return
+            self._dead[aid] = time.monotonic()
+            self._inflight.pop(aid, None)
+            self._cond.notify_all()
+        try:
+            self._controller.check_replicas.remote(self.deployment)
+        except Exception:
+            pass
+
+    # ---------------- request paths ----------------
+
+    def route(self, args, kwargs):
+        """Plain request. One transparent re-admission if the replica died
+        (at-least-once on replica failure — parity with the per-handle
+        router's recovery semantics)."""
+        timeout = float(self._config.get("request_timeout_s") or 300.0)
+        for attempt in range(2):
+            try:
+                aid, handle = self._admit()
+            except BackpressureError as e:
+                if attempt == 0:
+                    raise
+                # the FIRST attempt was dispatched (replica died mid
+                # -execution); a saturated re-admission must not claim
+                # "never reached a replica" — that is the
+                # BackpressureError retry-safety contract
+                raise ReplicaUnavailableError(
+                    self.deployment,
+                    detail="replica died mid-request; re-admission "
+                           "saturated",
+                ) from e
+            t0 = time.monotonic()
+            try:
+                out = ray_tpu.get(
+                    handle.handle_request.remote(
+                        list(args), dict(kwargs or {})
+                    ),
+                    timeout=timeout,
+                )
+                self._ttft.record((time.monotonic() - t0) * 1e3)
+                return out
+            except (ActorDiedError, ActorUnavailableError) as e:
+                self._mark_dead(aid)
+                if attempt == 0:
+                    self._reroutes += 1
+                    self._refresh_soon()
+                    continue
+                raise ReplicaUnavailableError(
+                    self.deployment, detail=str(e)
+                ) from e
+            finally:
+                self._release(aid)
+
+    def route_stream(self, args, kwargs):
+        """Streaming request: chunks pass through as the replica yields
+        them (replica -> router -> caller, all on the caller-owned
+        streaming generator protocol). A replica death mid-stream raises
+        the typed retryable ``ReplicaUnavailableError`` — the consumer
+        has the already-delivered chunks in hand and decides."""
+        aid, handle = self._admit()
+        with self._cond:
+            self._streams_active += 1
+        inner = None
+        t0 = time.monotonic()
+        first = True
+        try:
+            inner = handle.handle_stream.options(
+                num_returns="streaming"
+            ).remote(list(args), dict(kwargs or {}))
+            for ref in inner:
+                val = ray_tpu.get(ref)
+                if first:
+                    self._ttft.record((time.monotonic() - t0) * 1e3)
+                    first = False
+                yield val
+        except (ActorDiedError, ActorUnavailableError) as e:
+            self._mark_dead(aid)
+            raise ReplicaUnavailableError(
+                self.deployment, detail=str(e)
+            ) from e
+        finally:
+            if inner is not None:
+                try:
+                    inner.close()  # consumer gone/errored: stop the replica
+                except Exception:
+                    pass
+            with self._cond:
+                self._streams_active -= 1
+            self._release(aid)
+
+    def _refresh_soon(self):
+        """Synchronous reconcile+refresh after a death: the retry must
+        see the post-reconcile replica set, not the cached one."""
+        try:
+            ray_tpu.get(
+                self._controller.check_replicas.remote(self.deployment),
+                timeout=60,
+            )
+        except Exception:
+            pass
+        try:
+            self._refresh(force=True)
+        except Exception:
+            pass
+
+    # ---------------- introspection ----------------
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._cond:
+            inflight = dict(self._inflight)
+            queued = self._queued
+            rejected = self._rejected
+            routed = self._routed
+            reroutes = self._reroutes
+            streams = self._streams_active
+            replicas = len(self._replicas)
+            dead = len(self._dead)
+        pct = self._ttft.percentiles()
+        return {
+            "deployment": self.deployment,
+            "replicas": replicas,
+            "dead_replicas": dead,
+            "capacity": self._cap() * max(0, replicas - dead),
+            "ongoing": sum(inflight.values()),
+            "queued": queued,
+            "streams_active": streams,
+            "routed_total": routed,
+            "rejected_total": rejected,
+            "reroutes_total": reroutes,
+            "ttft_n": pct["n"],
+            "ttft_p50_ms": round(pct["p50"], 2),
+            "ttft_p95_ms": round(pct["p95"], 2),
+        }
+
+    def health(self):
+        return "ok"

@@ -39,6 +39,11 @@ class DeploymentSchema:
     user_config: Optional[Dict[str, Any]] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
     batch_max_size: Optional[int] = None
+    # shared-router admission (r9): per-replica in-flight cap + bounded
+    # admission queue (see serve.deployment for semantics)
+    max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
+    max_queue_wait_s: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Dict) -> "DeploymentSchema":
@@ -171,7 +176,8 @@ def _apply_overrides(dep, schema: Optional[DeploymentSchema]):
         return dep
     opts = {}
     for key in ("num_replicas", "user_config", "autoscaling_config",
-                "batch_max_size"):
+                "batch_max_size", "max_ongoing_requests",
+                "max_queued_requests", "max_queue_wait_s"):
         val = getattr(schema, key)
         if val is not None:
             opts[key] = val

@@ -1,0 +1,325 @@
+"""Broadcast-tree weight fan-out: K simultaneous pulls of one large
+object form a pull tree instead of K-x'ing the source NIC.
+
+Covers the r9 object-plane tentpole: the GCS pull registry
+(``pull_begin``/``pull_end``) assigns each concurrent puller an
+earlier-arrived puller as its tree parent; the parent serves landed
+chunk ranges of its own IN-PROGRESS pull onward (raylet partial serve),
+so source egress stays O(fanout) while every puller lands a
+byte-identical copy — with failover when a tree-interior peer or the
+source itself dies mid-fan-out.
+
+Parity: reference PullManager dedup + PushManager fan-out
+(pull_manager.h:52, push_manager.h:30).
+"""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.cluster_utils import Cluster
+
+
+def _chunksum(cli, oid_bytes, size, step=8 << 20):
+    h = hashlib.sha256()
+    off = 0
+    while off < size:
+        n = min(step, size - off)
+        h.update(cli.call("read_object_chunk", [oid_bytes, off, n],
+                          timeout=60))
+        off += n
+    return h.hexdigest()
+
+
+def _transfer(cli):
+    return cli.call("node_stats", None, timeout=30)["transfer"]
+
+
+def _concurrent_pulls(clis, oid_bytes, timeout=300):
+    results = [None] * len(clis)
+
+    def pull(i):
+        try:
+            results[i] = clis[i].call("pull_object", oid_bytes,
+                                      timeout=timeout, retry=False)
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            results[i] = e
+
+    ts = [threading.Thread(target=pull, args=(i,))
+          for i in range(len(clis))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    return results
+
+
+def _run_fanout(size_mb: int, k: int, max_egress_ratio: float):
+    """Shared body: K simultaneous pulls, byte-identity on every puller,
+    and node_stats["transfer"] proof that source egress grew
+    sub-linearly in K."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+        system_config={
+            "object_transfer_chunk_bytes": 512 * 1024,
+            "object_store_memory_bytes": max(
+                128, size_mb * 3
+            ) * 1024 * 1024,
+            # the tree saves the NIC: exercise the socket plane
+            "object_transfer_same_host_shm": False,
+            "object_broadcast_min_bytes": 4 * 1024 * 1024,
+            "prestart_workers": False,
+        },
+    )
+    try:
+        nodes = [c.add_node(num_cpus=1, resources={f"n{i}": 1})
+                 for i in range(k)]
+        c.connect()
+        arr = np.random.randint(0, 255, size_mb * 1024 * 1024,
+                                dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+        head_hex = c.head_node.node_id.hex()
+        cli_head = rpc.Client.connect(info[head_hex]["raylet_addr"],
+                                      name="bt-h")
+        clis = [
+            rpc.Client.connect(info[n.node_id.hex()]["raylet_addr"],
+                               name=f"bt-{i}")
+            for i, n in enumerate(nodes)
+        ]
+        src_meta = cli_head.call("read_object_meta", ref.binary(),
+                                 timeout=30)
+        want = _chunksum(cli_head, ref.binary(), src_meta["size"])
+        base_out = _transfer(cli_head)["bytes_out"]
+
+        results = _concurrent_pulls(clis, ref.binary())
+        assert all(r is True for r in results), results
+
+        # acceptance: source egress sub-linear in K, proven in
+        # node_stats["transfer"] (vs ~K x a single copy without the tree)
+        head_out = _transfer(cli_head)["bytes_out"] - base_out
+        ratio = head_out / src_meta["size"]
+        assert ratio <= max_egress_ratio, (
+            f"source egress {ratio:.2f}x of one copy for K={k} "
+            f"(tree should keep it <= {max_egress_ratio}x)"
+        )
+        stats = [_transfer(cl) for cl in clis]
+        # the tree actually formed: pulls rode parents, and interior
+        # nodes relayed partial chunks onward
+        assert sum(s["tree_pulls"] for s in stats) >= max(1, k - 2), stats
+        relayed = sum(s["partial_chunks_out"] for s in stats)
+        assert relayed + head_out >= src_meta["size"] // (512 * 1024), (
+            relayed, head_out,
+        )
+        # byte-identical everywhere; no leaked transfer state
+        for i, cl in enumerate(clis):
+            meta = cl.call("read_object_meta", ref.binary(), timeout=30)
+            assert meta["size"] == src_meta["size"]
+            assert _chunksum(cl, ref.binary(), meta["size"]) == want, (
+                f"puller {i} bytes differ"
+            )
+            t = _transfer(cl)
+            assert t["chunks_inflight"] == 0, t
+            assert t["partial_serves_open"] == 0, t
+            assert t["peer_conns"]["in_use"] == 0, t
+        for cl in clis + [cli_head]:
+            cl.close()
+    finally:
+        c.shutdown()
+
+
+def test_broadcast_tree_k4_sublinear_egress_and_byte_identity():
+    _run_fanout(size_mb=24, k=4, max_egress_ratio=2.0)
+
+
+@pytest.mark.slow
+def test_broadcast_tree_k4_256mib_acceptance():
+    """The literal acceptance bar: K=4 replicas pulling one 256 MiB+
+    object cost the source <= ~2x a single copy (vs ~4x without the
+    tree)."""
+    _run_fanout(size_mb=256, k=4, max_egress_ratio=2.0)
+
+
+def test_broadcast_tree_interior_peer_death_failover():
+    """Kill a tree-INTERIOR puller mid-fan-out: its children exclude it,
+    walk up to an ancestor or the source via pull_begin re-assignment,
+    and still land intact full copies (checksums match the source)."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+        system_config={
+            # many slow round trips: the fan-out is reliably mid-flight
+            # when the interior peer dies
+            "object_transfer_chunk_bytes": 64 * 1024,
+            "object_transfer_window": 2,
+            "object_store_memory_bytes": 192 * 1024 * 1024,
+            "object_transfer_same_host_shm": False,
+            "object_broadcast_min_bytes": 1 * 1024 * 1024,
+            "prestart_workers": False,
+        },
+    )
+    try:
+        k = 3
+        nodes = [c.add_node(num_cpus=1, resources={f"n{i}": 1})
+                 for i in range(k)]
+        c.connect()
+        arr = np.random.randint(0, 255, 12 * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+        head_hex = c.head_node.node_id.hex()
+        cli_head = rpc.Client.connect(info[head_hex]["raylet_addr"],
+                                      name="bt-h")
+        clis = [
+            rpc.Client.connect(info[n.node_id.hex()]["raylet_addr"],
+                               name=f"bt-{i}")
+            for i, n in enumerate(nodes)
+        ]
+        src_meta = cli_head.call("read_object_meta", ref.binary(),
+                                 timeout=30)
+        want = _chunksum(cli_head, ref.binary(), src_meta["size"])
+
+        results = [None] * k
+
+        def pull(i):
+            try:
+                results[i] = clis[i].call(
+                    "pull_object", ref.binary(), timeout=300, retry=False
+                )
+            except Exception as e:  # noqa: BLE001
+                results[i] = e
+
+        ts = [threading.Thread(target=pull, args=(i,)) for i in range(k)]
+        for t in ts:
+            t.start()
+        # the tree root (registry position 0) is the interior peer every
+        # later arrival hangs off — kill it once the fan-out is actually
+        # mid-flight (bytes moving AND a tree pull engaged)
+        deadline = time.monotonic() + 60
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            started = any(
+                _transfer(cl)["bytes_in"] > 0 for cl in clis
+            )
+            engaged = any(
+                _transfer(cl)["tree_pulls"] > 0 for cl in clis
+            )
+            if started and engaged:
+                for i, cl in enumerate(clis):
+                    if _transfer(cl)["tree_position"] == 0:
+                        victim = i
+                        break
+            time.sleep(0.02)
+        assert victim is not None, "fan-out never engaged the tree"
+        handle = [n for n in c._impl.nodes.values()
+                  if n.node_id.hex() == nodes[victim].node_id.hex()][0]
+        handle.proc.kill()
+        for t in ts:
+            t.join(timeout=300)
+
+        survivors = [i for i in range(k) if i != victim]
+        assert all(results[i] is True for i in survivors), results
+        for i in survivors:
+            meta = clis[i].call("read_object_meta", ref.binary(),
+                                timeout=30)
+            assert _chunksum(clis[i], ref.binary(), meta["size"]) == want
+            t = _transfer(clis[i])
+            assert t["chunks_inflight"] == 0, t
+            assert t["partial_serves_open"] == 0, t
+        for i in survivors:
+            clis[i].close()
+        cli_head.close()
+    finally:
+        c.shutdown()
+
+
+def test_broadcast_tree_source_death_failover():
+    """Mid-fan-out SOURCE death with a second sealed holder alive: the
+    pullers' location refresh + parent re-assignment fail over to the
+    surviving holder and every pull still lands the source's bytes."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+        system_config={
+            "object_transfer_chunk_bytes": 64 * 1024,
+            "object_transfer_window": 2,
+            "object_store_memory_bytes": 192 * 1024 * 1024,
+            "object_transfer_same_host_shm": False,
+            "object_broadcast_min_bytes": 1 * 1024 * 1024,
+            "prestart_workers": False,
+        },
+    )
+    try:
+        src = c.add_node(num_cpus=2, resources={"src": 1})
+        c.connect()
+
+        @ray_tpu.remote(num_cpus=1, resources={"src": 0.01})
+        def make_big():
+            return np.random.randint(0, 255, 12 * 1024 * 1024,
+                                     dtype=np.uint8)
+
+        ref = make_big.remote()  # lands on the src node
+        ray_tpu.wait([ref], timeout=120, fetch_local=False)
+
+        info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+        head_hex = c.head_node.node_id.hex()
+        cli_head = rpc.Client.connect(info[head_hex]["raylet_addr"],
+                                      name="sd-h")
+        cli_src = rpc.Client.connect(
+            info[src.node_id.hex()]["raylet_addr"], name="sd-s"
+        )
+        # second sealed holder: the head pulls a full copy first
+        assert cli_head.call("pull_object", ref.binary(), timeout=120,
+                             retry=False) is True
+        src_meta = cli_src.call("read_object_meta", ref.binary(),
+                                timeout=30)
+        want = _chunksum(cli_head, ref.binary(), src_meta["size"])
+
+        pullers = [c.add_node(num_cpus=1, resources={f"p{i}": 1})
+                   for i in range(2)]
+        info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+        clis = [
+            rpc.Client.connect(info[n.node_id.hex()]["raylet_addr"],
+                               name=f"sd-{i}")
+            for i, n in enumerate(pullers)
+        ]
+
+        results = [None] * 2
+
+        def pull(i):
+            try:
+                results[i] = clis[i].call(
+                    "pull_object", ref.binary(), timeout=300, retry=False
+                )
+            except Exception as e:  # noqa: BLE001
+                results[i] = e
+
+        ts = [threading.Thread(target=pull, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(_transfer(cl)["bytes_in"] > 0 for cl in clis):
+                break
+            time.sleep(0.02)
+        handle = [n for n in c._impl.nodes.values()
+                  if n.node_id.hex() == src.node_id.hex()][0]
+        handle.proc.kill()
+        for t in ts:
+            t.join(timeout=300)
+
+        assert all(r is True for r in results), results
+        for i, cl in enumerate(clis):
+            meta = cl.call("read_object_meta", ref.binary(), timeout=30)
+            assert _chunksum(cl, ref.binary(), meta["size"]) == want, (
+                f"puller {i} bytes differ after source death"
+            )
+        for cl in clis + [cli_head]:
+            cl.close()
+    finally:
+        c.shutdown()

@@ -186,6 +186,61 @@ def test_r3_covers_conduit_batch_send():
     assert findings == []
 
 
+def test_r3_covers_raylet_fanout_sends():
+    """R3 extends to raylet.py (r9): the broadcast-tree partial-serve
+    path pushes chunk frames from the raylet, so a direct engine/writer
+    send added there bypasses the chaos gates exactly like one in the
+    wire modules — it must route through the gated send helpers."""
+    bad = textwrap.dedent(
+        """
+        def serve_partial_chunk(self, conn, payload):
+            self.engine.send(conn.conn_id, payload)
+        """
+    )
+    findings, _ = lint_source(bad, "raylet.py")
+    assert any(f.rule == "R3" for f in findings)
+    # the real fan-out path is clean: it sends via conn.send_raw_frame
+    # (gated inside the wire modules), never a bare engine/writer call
+    good = textwrap.dedent(
+        """
+        def serve_partial_chunk(self, conn, payload, token, off, n):
+            conn.send_raw_frame(
+                0, None, "obj_chunk", [off, n], payload,
+                token=token, off=off,
+            )
+        """
+    )
+    findings, _ = lint_source(good, "raylet.py")
+    assert findings == []
+
+
+def test_r4_covers_serve_router_randomness():
+    """R4 extends to serve/router.py (r9): replica picks are routing
+    decisions a replayed chaos schedule must meet again, so the router
+    may only draw from chaos.replay_rng — OS-seeded ``random`` draws
+    anywhere in the module are findings."""
+    bad = textwrap.dedent(
+        """
+        import random
+        def _pick(self, n):
+            a, b = random.sample(range(n), 2)
+            return a if self._inflight[a] <= self._inflight[b] else b
+        """
+    )
+    findings, _ = lint_source(bad, "router.py")
+    assert any(f.rule == "R4" for f in findings)
+    good = textwrap.dedent(
+        """
+        from ray_tpu._private import chaos as _chaos
+        def _pick(self, n):
+            a, b = self._rng.sample(range(n), 2)
+            return a if self._inflight[a] <= self._inflight[b] else b
+        """
+    )
+    findings, _ = lint_source(good, "router.py")
+    assert findings == []
+
+
 def test_suppression_by_rule_name_and_def_line():
     path, bad, _ = CORPUS["R1"]
     src = textwrap.dedent(bad).replace(

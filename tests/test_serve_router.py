@@ -1,0 +1,354 @@
+"""Serving plane: shared Router actor — admission, backpressure,
+streaming pass-through, SLO autoscaling, replica-death recovery.
+
+Covers the r9 tentpole: deployments with ``max_ongoing_requests`` route
+every client through ONE Router actor (``serve/router.py``) — power of
+two choices over deployment-wide per-replica queue depths, a hard
+per-replica in-flight cap, a bounded admission queue with typed
+``BackpressureError`` rejection (HTTP: 503 + Retry-After), streaming
+pass-through proxy -> router -> replica, and the TTFT/queue-depth
+reports that drive the controller's SLO autoscaler.
+
+Parity: reference ``python/ray/serve/_private/router.py:856`` replica
+scheduler + max_ongoing_requests semantics.
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import chaos
+
+
+@pytest.fixture
+def rt_serve():
+    ray_tpu.init(num_cpus=6, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _router_metrics(name):
+    ctrl = serve._get_or_start_controller()
+    router = ray_tpu.get(ctrl.get_router.remote(name), timeout=30)
+    assert router is not None
+    return ray_tpu.get(router.metrics.remote(), timeout=30)
+
+
+def test_admission_cap_queue_and_typed_backpressure(rt_serve):
+    """One replica, in-flight cap 1, queue bound 1: the first request
+    occupies the slot, the second queues, the third is rejected with the
+    TYPED BackpressureError (carrying retry_after_s) — never an opaque
+    error, never an unbounded buffer."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=1,
+                      max_queue_wait_s=20.0)
+    class Slow:
+        def __call__(self, secs):
+            time.sleep(secs)
+            return "done"
+
+    h = serve.run(Slow.bind())
+    assert h.remote(0.0).result(timeout=120) == "done"
+
+    f1 = h.remote(3.0)
+    f2 = h.remote(0.0)  # queues behind f1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        m = _router_metrics("Slow")
+        if m["ongoing"] >= 1 and m["queued"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"saturation never observed: {m}")
+
+    with pytest.raises(serve.BackpressureError) as ei:
+        h.remote(0.0).result(timeout=30)
+    assert ei.value.retry_after_s > 0
+    assert ei.value.deployment == "Slow"
+    assert getattr(ei.value, "retryable", False) is True
+
+    assert f1.result(timeout=120) == "done"
+    assert f2.result(timeout=120) == "done"
+    # no leaked slots: capacity fully returns after the drain
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        m = _router_metrics("Slow")
+        if m["ongoing"] == 0 and m["queued"] == 0:
+            break
+        time.sleep(0.1)
+    assert m["ongoing"] == 0 and m["queued"] == 0, m
+    assert m["rejected_total"] >= 1
+
+
+def test_http_ingress_maps_backpressure_to_503(rt_serve):
+    """Satellite: the HTTP proxy surfaces router admission rejection as
+    503 + Retry-After on BOTH the plain and the streaming endpoint —
+    not an opaque 500, not unbounded queueing."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0,
+                      max_queue_wait_s=0.2)
+    class Busy:
+        def __call__(self, payload):
+            time.sleep(payload.get("sleep", 0) if payload else 0)
+            return "ok"
+
+        def stream(self, payload):
+            yield "tok"
+
+    h = serve.run(Busy.bind())
+    assert h.remote({}).result(timeout=120) == "ok"
+    base = serve.start_http_proxy()
+
+    blocker = h.remote({"sleep": 5.0})  # occupy the only slot
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if _router_metrics("Busy")["ongoing"] >= 1:
+            break
+        time.sleep(0.05)
+
+    def post(path):
+        req = urllib.request.Request(
+            f"{base}/{path}", data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req, timeout=60)
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post("Busy")
+    assert e.value.code == 503
+    assert int(e.value.headers["Retry-After"]) >= 1
+    assert "retry_after_s" in json.loads(e.value.read())
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post("Busy/stream")
+    assert e.value.code == 503
+    assert int(e.value.headers["Retry-After"]) >= 1
+
+    assert blocker.result(timeout=120) == "ok"
+    # capacity restored: the proxy path serves again (200), and the
+    # streaming endpoint passes chunks through router -> replica
+    body = json.loads(post("Busy").read())
+    assert body["result"] == "ok"
+    lines = [json.loads(x) for x in post("Busy/stream").read().splitlines()]
+    assert lines == [{"chunk": "tok"}]
+
+
+def test_streaming_pass_through_and_ttft_metrics(rt_serve):
+    """Tokens ride proxy -> router -> replica on the streaming generator
+    protocol; the router records TTFT samples and its in-flight
+    accounting returns to zero when streams drain (no leaked slots)."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=2)
+    class Tok:
+        def stream(self, n):
+            for i in range(n):
+                time.sleep(0.01)
+                yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(Tok.bind())
+    streams = [h.stream(5) for _ in range(4)]
+    outs = [[c["i"] for c in s] for s in streams]
+    assert all(o == list(range(5)) for o in outs)
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        m = _router_metrics("Tok")
+        if m["ongoing"] == 0 and m["streams_active"] == 0:
+            break
+        time.sleep(0.1)
+    assert m["ongoing"] == 0 and m["streams_active"] == 0, m
+    assert m["ttft_n"] >= 4 and m["ttft_p95_ms"] > 0, m
+    assert m["routed_total"] >= 4
+
+
+@pytest.mark.chaos
+def test_replica_sigkill_mid_stream_recovery(rt_serve):
+    """Chaos satellite: SIGKILL a replica mid-stream at a point drawn
+    from a seeded ``_private/chaos.py`` plane. The router marks it dead,
+    queued (not-yet-started) requests re-admit onto the survivor, the
+    in-flight stream on the victim fails with the TYPED retryable
+    ReplicaUnavailableError, the controller restarts the replica, and no
+    slots leak."""
+    chaos.install(chaos.make_spec(seed=1234))
+    try:
+        kill_after_chunks = chaos.replay_rng(
+            "serve-replica-kill"
+        ).randrange(2, 5)
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=1,
+                          max_queued_requests=8, max_queue_wait_s=60.0)
+        class Tok:
+            def stream(self, n):
+                for i in range(n):
+                    time.sleep(0.05)
+                    yield {"i": i, "pid": os.getpid()}
+
+        h = serve.run(Tok.bind())
+        # cap 1 + two replicas: two live streams MUST sit on distinct
+        # replicas — their pids identify victim and survivor
+        s1, s2 = h.stream(60), h.stream(60)
+        pid1 = next(iter(s1))["pid"]
+        pid2 = next(iter(s2))["pid"]
+        assert pid1 != pid2
+
+        # queue two not-yet-started requests behind the full deployment
+        q1, q2 = h.stream(3), h.stream(3)
+
+        for _ in range(kill_after_chunks):
+            next(s1)
+        os.kill(pid1, signal.SIGKILL)
+
+        # in-flight stream on the victim: typed retryable failure
+        with pytest.raises(serve.ReplicaUnavailableError) as ei:
+            for _ in s1:
+                pass
+        assert getattr(ei.value, "retryable", False) is True
+
+        # queued requests re-admit to the survivor (and/or the restarted
+        # replica) and complete
+        assert [c["i"] for c in q1] == [0, 1, 2]
+        assert [c["i"] for c in q2] == [0, 1, 2]
+        s2.close()  # survivor stream: abandoned cleanly
+
+        # controller replaces the dead replica; traffic spreads again
+        deadline = time.monotonic() + 60
+        pids = set()
+        while time.monotonic() < deadline:
+            try:
+                pids = {next(iter(h.stream(1)))["pid"] for _ in range(6)}
+                if len(pids) == 2 and pid1 not in pids:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert len(pids) == 2 and pid1 not in pids, pids
+
+        # no leaked slots after the dust settles
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            m = _router_metrics("Tok")
+            if m["ongoing"] == 0 and m["streams_active"] == 0 and (
+                m["dead_replicas"] == 0
+            ):
+                break
+            time.sleep(0.2)
+        assert m["ongoing"] == 0 and m["streams_active"] == 0, m
+        assert m["dead_replicas"] == 0, m
+    finally:
+        chaos.uninstall()
+
+
+def test_slo_autoscaling_up_on_ttft_burn_and_down_on_idle(rt_serve):
+    """Tentpole loop closure: the controller consumes router-reported
+    TTFT p95 + queue depth. A deployment whose single replica blows the
+    TTFT SLO scales OUT even though its in-flight count alone would not
+    demand it; sustained idle scales back to min."""
+
+    @serve.deployment(
+        max_ongoing_requests=4,
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 2,
+            # in-flight never exceeds the per-replica target -> the
+            # ongoing-based policy alone would NEVER scale up
+            "target_ongoing_requests": 8,
+            "ttft_slo_ms": 40.0,
+            "upscale_delay_s": 0.5,
+            "downscale_delay_s": 2.0,
+        },
+    )
+    class SloTok:
+        def stream(self, n):
+            time.sleep(0.25)  # first token blows the 40ms SLO
+            for i in range(n):
+                yield i
+
+    h = serve.run(SloTok.bind())
+    assert serve.status()["SloTok"]["num_replicas"] == 1
+
+    stop = time.monotonic() + 45
+    peak = 1
+    while time.monotonic() < stop and peak < 2:
+        list(h.stream(2))  # each stream records a ~250ms TTFT sample
+        peak = max(peak, serve.status()["SloTok"]["num_replicas"])
+    assert peak >= 2, "TTFT-SLO burn never scaled the deployment out"
+
+    # idle: the sustained-idle policy shrinks back to min_replicas
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if serve.status()["SloTok"]["num_replicas"] == 1:
+            break
+        time.sleep(0.5)
+    assert serve.status()["SloTok"]["num_replicas"] == 1
+
+
+def test_scaleup_fires_provision_hook(rt_serve, tmp_path):
+    """Satellite: autoscaler scale-ups optionally provision capacity —
+    the hook fires with (deployment, old_n, new_n) on each scale-up
+    event, and the shipped QueuedResourceProvisioner files one
+    queued-resource request per added replica through the mock API."""
+    marker = str(tmp_path / "provisioned.jsonl")
+
+    def hook(name, old_n, new_n, _path=marker):
+        with open(_path, "a") as f:
+            f.write(json.dumps([name, old_n, new_n]) + "\n")
+
+    @serve.deployment(
+        max_ongoing_requests=1,
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 2,
+            "target_ongoing_requests": 1,
+            "ttft_slo_ms": 30.0, "upscale_delay_s": 0.3,
+            "downscale_delay_s": 300.0,
+            "provision_hook": hook,
+        },
+    )
+    class Busy:
+        def __call__(self, _):
+            time.sleep(0.3)
+            return os.getpid()
+
+    h = serve.run(Busy.bind())
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        futs = [h.remote(i) for i in range(3)]
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except serve.BackpressureError:
+                pass
+        if serve.status()["Busy"]["num_replicas"] >= 2:
+            break
+    assert serve.status()["Busy"]["num_replicas"] >= 2
+    # the hook ran in the controller process on the same host
+    deadline = time.monotonic() + 10
+    events = []
+    while time.monotonic() < deadline and not events:
+        if os.path.exists(marker):
+            with open(marker) as f:
+                events = [json.loads(x) for x in f if x.strip()]
+        time.sleep(0.2)
+    assert events and events[0][0] == "Busy", events
+    assert events[0][2] > events[0][1]
+
+
+def test_queued_resource_provisioner_unit():
+    """QueuedResourceProvisioner files one queued-resource request per
+    added replica through a TpuApiClient-compatible provider."""
+    from ray_tpu.cloud_provider import MockTpuApi
+    from ray_tpu.serve.controller import QueuedResourceProvisioner
+
+    api = MockTpuApi()
+    prov = QueuedResourceProvisioner(
+        lambda: api, accelerator_type="v5e-4",
+        runtime_version="v2-alpha-tpuv5-lite", name_prefix="t",
+    )
+    prov("mydep", 1, 3)
+    names = {q["name"] for q in api.list_queued_resources()}
+    assert {"t-mydep-1", "t-mydep-2"} <= names

@@ -24,7 +24,7 @@ introduced the invariant):
 
 R1 async-blocking          blocking calls inside ``async def`` in _private/
 R2 handler-no-dedup        handler dispatch outside rpc.run_idempotent
-R3 send-bypasses-chaos     wire sends in rpc.py/conduit_rpc.py off the chaos hook
+R3 send-bypasses-chaos     wire sends in rpc.py/conduit_rpc.py/raylet.py off the chaos hook
 R4 unseeded-randomness     unseeded random/time in replay-deterministic code
 R5 writable-view-escape    Store.get(writable=True) outside the pin path
 R6 swallowed-cancellation  bare except / swallowed CancelledError in async code

@@ -66,8 +66,15 @@ _R1_BLOCKING = {
 _R1_FILE = {"open", "os.listdir", "os.stat", "os.path.getsize"}
 
 #: R3 scope + R4 module-prong scope (wire/control modules by basename).
-_R3_FILES = {"rpc.py", "conduit_rpc.py"}
-_R4_FILES = {"chaos.py", "rpc.py", "conduit_rpc.py", "raylet.py", "gcs.py"}
+#: raylet.py joined R3 in r9: the broadcast-tree fan-out serves chunk
+#: frames from the raylet — a direct engine/writer send added there
+#: would bypass the chaos gates exactly like one in the wire modules.
+_R3_FILES = {"rpc.py", "conduit_rpc.py", "raylet.py"}
+#: router.py (serve) joined R4 in r9: replica picks are routing decisions
+#: a replayed chaos schedule must meet again — they draw from
+#: chaos.replay_rng, never the OS-seeded random module.
+_R4_FILES = {"chaos.py", "rpc.py", "conduit_rpc.py", "raylet.py", "gcs.py",
+             "router.py"}
 
 #: R4: draws on the process-global (OS-seeded) random module.
 _R4_DRAWS = {
