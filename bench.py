@@ -470,6 +470,19 @@ def main():
                 )
             except Exception as e:
                 micro["weight_fanout"] = {"error": str(e)[:160]}
+            # compute plane (r10): gang spin-up + lockstep compiled
+            # steps/s of a 2-host CPU MeshGroup (STRICT_SPREAD
+            # placement, TCP rendezvous, pjit dispatch). Subprocess-
+            # isolated.
+            from ray_tpu._private.ray_perf import run_mesh_group_bench
+
+            try:
+                micro["mesh_group"] = run_mesh_group_bench()
+                micro["mesh_group_steps_per_s"] = (
+                    micro["mesh_group"]["steps_per_s"]
+                )
+            except Exception as e:
+                micro["mesh_group"] = {"error": str(e)[:160]}
             if accel_unreachable:
                 # the RL learner uses driver-side jax, which the wedged
                 # probe thread may deadlock — everything above is numpy
@@ -514,6 +527,10 @@ def main():
         # traffic against the autoscaled deployment (dev box ~85-90;
         # floor at roughly half, ratchet owns same-box regressions)
         "serving_tokens_per_s_per_replica": 40.0,
+        # compute plane (r10): gang-coherent lockstep steps/s on the
+        # 2-host CPU MeshGroup (dev box ~290; backstop at an order of
+        # magnitude under, the 0.98x ratchet owns same-box regressions)
+        "mesh_group_steps_per_s": 30.0,
     }
     floors = ratchet_floors(STATIC_FLOORS)
     violations = []
@@ -552,6 +569,16 @@ def main():
                 violations.append({
                     "metric": "serving_rejected_ratio",
                     "value": sv.get("rejected_ratio"), "floor": "<= 0.3",
+                })
+        mgb = micro.get("mesh_group") or {}
+        if "error" not in mgb and mgb:
+            # gang spin-up is a latency contract (recover() pays it per
+            # re-place): generous static ceiling, steps/s rides the
+            # ratcheted floor above
+            if (mgb.get("spinup_s") or 1e9) > 60.0:
+                violations.append({
+                    "metric": "mesh_group_spinup_s",
+                    "value": mgb.get("spinup_s"), "floor": "<= 60",
                 })
         wf = micro.get("weight_fanout") or {}
         if "error" not in wf and wf:

@@ -213,3 +213,12 @@ _d("gcs_journal_fsync", bool, False)
 _d("gcs_actor_recovery_grace_s", float, 10.0)
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)
+# --- mesh groups (gang-scheduled multi-host pjit) ---
+# STRICT_SPREAD gang reservation + worker boot budget
+_d("mesh_group_placement_timeout_s", float, 120.0)
+# jax.distributed rendezvous + global-mesh build budget (covers every
+# rank's first jax init)
+_d("mesh_group_rendezvous_timeout_s", float, 180.0)
+# per-lockstep-call budget (compile / run_step / save / restore); a rank
+# missing the deadline breaks the gang exactly like a rank death
+_d("mesh_group_step_timeout_s", float, 300.0)

@@ -1834,8 +1834,11 @@ class CoreWorker:
         if info and info.get("pinned"):
             self._pin_handoff(info["pinned"])
         if not isinstance(error, exc.RayTpuError):
+            # str() of a bare TimeoutError/CancelledError is "" — keep
+            # the type name in the surfaced diagnostics
             error = exc.TaskError(
-                function_name=spec.name, traceback_str=str(error), cause=error
+                function_name=spec.name,
+                traceback_str=str(error) or repr(error), cause=error
             )
         for r in spec.return_ids():
             self.memory_store.put_error(r, error)
@@ -2143,7 +2146,19 @@ class CoreWorker:
         ``wait_alive=False`` and the actor is not yet ALIVE)."""
         sleep = 0.05
         while True:
-            rec = await self.gcs.conn.call_async("get_actor", actor_id, timeout=30)
+            try:
+                rec = await self.gcs.conn.call_async("get_actor", actor_id,
+                                                     timeout=30)
+            except Exception:
+                # idempotent read: a chaos-dropped frame (or a GCS link
+                # mid-reconnect) must cost one poll interval, NOT fail
+                # the caller's task with a bare TimeoutError — but never
+                # spin against a tearing-down worker
+                if self._shutdown.is_set() or self.gcs.conn.closed:
+                    raise
+                await asyncio.sleep(sleep)
+                sleep = min(0.25, sleep * 1.5)
+                continue
             if rec is None:
                 return None
             self._actor_state_cache[actor_id] = rec["state"]

@@ -260,6 +260,12 @@ class GcsServer:
         # they re-register). Parents are always EARLIER arrivals, so the
         # assignment can never cycle.
         self._pulls: Dict[bytes, List[bytes]] = {}
+        # mesh-group registry: gang name -> controller-published record
+        # (membership, rendezvous epoch, steps, last failure). Transient
+        # observability like the pull registry — not journaled; the
+        # controller republishes on every state change, so a restarted
+        # GCS repopulates at the gang's next transition.
+        self.mesh_groups: Dict[str, Dict] = {}
         self._raylet_clients: Dict[bytes, rpc.Connection] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._started = asyncio.Event()
@@ -662,6 +668,47 @@ class GcsServer:
 
     async def rpc_get_all_nodes(self, conn, _):
         return [n.to_wire() for n in self.nodes.values()]
+
+    async def rpc_update_node_labels(self, conn, data):
+        """Merge a label patch into a live node's record (``None`` value
+        deletes the key) and republish it. An optional third element
+        ``expect`` ({key: value}) makes the patch conditional — applied
+        only while every expected key still holds its expected value
+        (compare-and-set, so a gang clearing its OWN stamp cannot wipe
+        a successor gang's). MeshGroup controllers stamp gang
+        membership here; the object plane's locality-aware stripe-peer
+        picker reads the labels off every raylet's cluster-node view.
+        Not journaled: labels reset to the raylet's registration values
+        on a GCS restart, and label owners (gangs) re-stamp at their
+        next transition."""
+        node_id, patch = bytes(data[0]), dict(data[1])
+        expect = dict(data[2]) if len(data) > 2 and data[2] else None
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return {"ok": False, "error": "unknown or dead node"}
+        if expect is not None and any(
+            info.labels.get(k) != v for k, v in expect.items()
+        ):
+            return {"ok": False, "error": "expectation failed"}
+        for key, val in patch.items():
+            if val is None:
+                info.labels.pop(key, None)
+            else:
+                info.labels[key] = str(val)
+        self._publish("nodes", [info.to_wire()])
+        return {"ok": True}
+
+    # -- mesh-group registry (gang observability; transient) --
+
+    async def rpc_mesh_group_update(self, conn, rec: Dict):
+        self.mesh_groups[str(rec["name"])] = dict(rec)
+        return {"ok": True}
+
+    async def rpc_mesh_group_remove(self, conn, name: str):
+        return {"ok": self.mesh_groups.pop(str(name), None) is not None}
+
+    async def rpc_mesh_group_table(self, conn, _):
+        return dict(self.mesh_groups)
 
     def _resource_view(self):
         return {

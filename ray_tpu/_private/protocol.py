@@ -127,6 +127,16 @@ def yield_object_id(tid: "TaskID", index: int) -> ObjectID:
     return ObjectID.from_task(tid, index + 2)
 
 
+# Well-known node-label keys. ``LABEL_HOST`` names the physical host a
+# (possibly simulated) node lives on — deployments feed real topology
+# here; ``LABEL_GANG`` is stamped by a MeshGroup onto its member nodes
+# for the gang's lifetime. The object plane's stripe-peer picker orders
+# pull sources same-host-first / same-gang-second off these labels so
+# weight/checkpoint pulls don't cross the DCN when a local copy exists.
+LABEL_HOST = "raytpu.io/host"
+LABEL_GANG = "raytpu.io/gang"
+
+
 @dataclasses.dataclass
 class NodeInfo:
     node_id: bytes

@@ -111,14 +111,13 @@ print(json.dumps({
 """
 
 
-def run_transfer_bench(size_mb: int = 256) -> Dict[str, float]:
-    """Two-raylet loopback pull bandwidth: a driver-put object of
-    ``size_mb`` MiB is pulled raylet-to-raylet (the windowed/striped
-    zero-copy plane) by timing the puller's ``pull_object`` RPC.
-
-    Runs in a SUBPROCESS with its own 2-node cluster so it composes with
-    an already-connected driver (the bench gate calls it while its own
-    cluster is up) and needs no accelerator (JAX pinned to cpu)."""
+def _run_isolated(label: str, code: str, argv=(),
+                  timeout: int = 900) -> Dict[str, float]:
+    """Shared subprocess harness for the isolated-cluster benches:
+    scrubbed env (own cluster, CPU-pinned jax, no inherited chaos or
+    cluster address), last-JSON-line result protocol, stderr tail on
+    failure. Every bench wrapper routes through here so an env-scrub
+    or parse fix lands once, not four times."""
     import json
     import os
     import subprocess
@@ -128,17 +127,28 @@ def run_transfer_bench(size_mb: int = 256) -> Dict[str, float]:
     env.pop("RAYTPU_CHAOS_SPEC", None)  # a chaotic bench is not a bench
     env.pop("RAYTPU_ADDRESS", None)     # own cluster, not the caller's
     r = subprocess.run(
-        [sys.executable, "-c", _TRANSFER_BENCH_CODE, str(size_mb)],
-        capture_output=True, text=True, timeout=900, env=env,
+        [sys.executable, "-c", code, *[str(a) for a in argv]],
+        capture_output=True, text=True, timeout=timeout, env=env,
     )
     for line in reversed(r.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             return json.loads(line)
     raise RuntimeError(
-        f"transfer bench produced no result (rc={r.returncode}): "
+        f"{label} bench produced no result (rc={r.returncode}): "
         f"{r.stderr[-500:]}"
     )
+
+
+def run_transfer_bench(size_mb: int = 256) -> Dict[str, float]:
+    """Two-raylet loopback pull bandwidth: a driver-put object of
+    ``size_mb`` MiB is pulled raylet-to-raylet (the windowed/striped
+    zero-copy plane) by timing the puller's ``pull_object`` RPC.
+
+    Runs in a SUBPROCESS with its own 2-node cluster so it composes with
+    an already-connected driver (the bench gate calls it while its own
+    cluster is up) and needs no accelerator (JAX pinned to cpu)."""
+    return _run_isolated("transfer", _TRANSFER_BENCH_CODE, [size_mb])
 
 
 _BROADCAST_BENCH_CODE = """
@@ -224,26 +234,7 @@ def run_broadcast_bench(size_mb: int = 64, k: int = 4) -> Dict[str, float]:
     the same weights). Records the fan-out wall seconds and the SOURCE
     egress ratio — the tree's whole point is that ratio staying O(fanout)
     instead of K. Subprocess-isolated like the transfer bench."""
-    import json
-    import os
-    import subprocess
-    import sys
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("RAYTPU_CHAOS_SPEC", None)
-    env.pop("RAYTPU_ADDRESS", None)
-    r = subprocess.run(
-        [sys.executable, "-c", _BROADCAST_BENCH_CODE, str(size_mb), str(k)],
-        capture_output=True, text=True, timeout=900, env=env,
-    )
-    for line in reversed(r.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(
-        f"broadcast bench produced no result (rc={r.returncode}): "
-        f"{r.stderr[-500:]}"
-    )
+    return _run_isolated("broadcast", _BROADCAST_BENCH_CODE, [size_mb, k])
 
 
 _SERVING_SCALE_CODE = """
@@ -353,6 +344,86 @@ finally:
 """
 
 
+_MESH_GROUP_BENCH_CODE = """
+import json, time
+import numpy as np
+from jax.sharding import PartitionSpec as P
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.mesh import MeshGroup, StateKey
+
+c = Cluster(
+    initialize_head=True,
+    head_node_args={"resources": {"CPU": 3}},
+    system_config={"prestart_workers": False, "log_to_driver": False},
+)
+try:
+    c.add_node(num_cpus=3)
+    c.connect()
+    t0 = time.perf_counter()
+    mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                   devices_per_host=2, name="bench_gang")
+    spinup_s = time.perf_counter() - t0
+
+    def init_state(ctx):
+        import jax, numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        glob = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+        sh = NamedSharding(ctx.mesh, P("dp", "tp"))
+        ctx.state["w"] = jax.make_array_from_callback(
+            glob.shape, sh, lambda idx: glob[idx])
+        return 1
+
+    def train_step(w, b):
+        w = w * 0.999 + b[:, None]
+        return w, w.sum()
+
+    mg.run(init_state)
+    t0 = time.perf_counter()
+    sid = mg.compile_step_with_plan(
+        train_step,
+        in_shardings=(P("dp", "tp"), P("dp")),
+        out_shardings=(P("dp", "tp"), P()),
+        donate_argnums=(0,),
+    )
+    compile_s = time.perf_counter() - t0
+    batch = np.ones((64,), np.float32)
+    # warmup + timed loop: each iteration is a full gang-coherent
+    # lockstep dispatch (controller -> 2 ranks -> cross-process pjit)
+    for _ in range(3):
+        mg.run_step(sid, StateKey("w"), batch, store={0: "w"})
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 3.0:
+        mg.run_step(sid, StateKey("w"), batch, store={0: "w"})
+        n += 1
+    steps_per_s = n / (time.perf_counter() - t0)
+    mg.shutdown()
+    print(json.dumps({
+        "spinup_s": round(spinup_s, 2),
+        "compile_s": round(compile_s, 2),
+        "steps_per_s": round(steps_per_s, 1),
+        "hosts": 2,
+        "mesh_shape": "dp2xtp2",
+    }))
+finally:
+    c.shutdown()
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+"""
+
+
+def run_mesh_group_bench() -> Dict[str, float]:
+    """MeshGroup micro: gang spin-up seconds (STRICT_SPREAD placement +
+    worker boot + TCP rendezvous to READY) and gang-coherent compiled
+    steps/s on a 2-host CPU mesh — the lockstep dispatch envelope.
+    Subprocess-isolated like the transfer bench."""
+    return _run_isolated("mesh group", _MESH_GROUP_BENCH_CODE,
+                         timeout=600)
+
+
 def run_serving_scale_bench() -> Dict[str, float]:
     """Serving-plane scale bench: sustained open-loop streamed traffic
     against an SLO-autoscaled deployment behind the shared Router actor.
@@ -361,26 +432,7 @@ def run_serving_scale_bench() -> Dict[str, float]:
     TTFT-SLO burn actually scales it out — and bounded backpressure
     rejections are part of the recorded contract. Subprocess-isolated
     (own cluster, CPU-pinned jax) like the transfer bench."""
-    import json
-    import os
-    import subprocess
-    import sys
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("RAYTPU_CHAOS_SPEC", None)
-    env.pop("RAYTPU_ADDRESS", None)
-    r = subprocess.run(
-        [sys.executable, "-c", _SERVING_SCALE_CODE],
-        capture_output=True, text=True, timeout=900, env=env,
-    )
-    for line in reversed(r.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(
-        f"serving_scale bench produced no result (rc={r.returncode}): "
-        f"{r.stderr[-500:]}"
-    )
+    return _run_isolated("serving_scale", _SERVING_SCALE_CODE)
 
 
 def run_microbenchmarks(

@@ -29,9 +29,27 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import SharedMemoryStore
-from ray_tpu._private.protocol import NodeInfo
+from ray_tpu._private.protocol import LABEL_GANG, LABEL_HOST, NodeInfo
 
 logger = logging.getLogger(__name__)
+
+
+def locality_class(my_labels: Optional[Dict[str, str]],
+                   peer_labels: Optional[Dict[str, str]]) -> int:
+    """Locality rank of a pull peer from node labels: 0 = same host
+    (``raytpu.io/host`` matches), 1 = same gang (``raytpu.io/gang``
+    matches — a MeshGroup stamps its members), 2 = everything else.
+    Pure label comparison, no I/O: a label a side lacks never matches,
+    so unlabeled clusters keep today's ordering exactly."""
+    mine = my_labels or {}
+    theirs = peer_labels or {}
+    host = mine.get(LABEL_HOST)
+    if host is not None and theirs.get(LABEL_HOST) == host:
+        return 0
+    gang = mine.get(LABEL_GANG)
+    if gang is not None and theirs.get(LABEL_GANG) == gang:
+        return 1
+    return 2
 
 
 class _LocationMiss(Exception):
@@ -335,6 +353,12 @@ class Raylet:
         self._partial_chunks_out = 0
         self._tree_pulls = 0
         self._tree_position: Optional[int] = None
+        # locality-aware stripe-peer picks: pulls whose first-choice
+        # source shared this node's host (or gang) label
+        self._locality_pref_hits = 0
+        # node_stats mesh-group cache (monotonic ts, dict): one GCS
+        # registry read per ~2s, however often stats are polled
+        self._mesh_group_cache: Tuple[float, Dict] = (0.0, {})
         # live actors hosted here: actor_id -> {"spec", "address"} — replayed
         # to a restarted GCS so its actor table survives (GCS FT)
         self.hosted_actors: Dict[bytes, Dict] = {}
@@ -498,7 +522,14 @@ class Raylet:
 
     def _on_nodes_update(self, nodes: List[Dict]):
         for n in nodes:
-            self.cluster_nodes[bytes(n["node_id"]).hex()] = n
+            nhex = bytes(n["node_id"]).hex()
+            self.cluster_nodes[nhex] = n
+            if nhex == self.node_id.hex():
+                # adopt GCS-side label patches (update_node_labels — a
+                # MeshGroup stamping gang membership) into OUR labels
+                # too, or the locality picker's same-gang prong never
+                # matches on the puller side
+                self.labels = dict(n.get("labels") or {})
         self._pump_infeasible()
 
     def _pump_infeasible(self, expire: bool = False):
@@ -1766,11 +1797,25 @@ class Raylet:
                 # tree (each completed pull registers a new location) instead
                 # of every node hammering the origin (push_manager.h:30 role)
                 self._rng.shuffle(cands)
+                # locality-aware stripe-peer preference (label-driven):
+                # same-host copies first, same-gang second, so MeshGroup
+                # weight/checkpoint pulls stay off the DCN when a local
+                # copy exists. The stable sort keeps the seeded shuffle
+                # order WITHIN each class — replay determinism intact.
+                cands.sort(
+                    key=lambda n: locality_class(self.labels,
+                                                 n.get("labels"))
+                )
                 if GLOBAL_CONFIG.object_transfer_same_host_shm:
                     for node in cands:
                         if await self._pull_same_host_shm(oid, node):
                             return True
                 addrs = [n["raylet_addr"] for n in cands]
+                loc_by_addr = {
+                    n["raylet_addr"]: locality_class(self.labels,
+                                                     n.get("labels"))
+                    for n in cands
+                }
                 paddrs = [n["raylet_addr"] for _, n in parent_nodes]
                 probe_n = min(len(addrs), max(stripe, 2))
                 t_meta = time.perf_counter()
@@ -1886,6 +1931,8 @@ class Raylet:
                 if not sources:
                     await asyncio.sleep(0.1 * (attempt + 1))
                     continue
+                if loc_by_addr.get(sources[0][0], 2) < 2:
+                    self._locality_pref_hits += 1
                 if await self._pull_striped(
                     oid, size, [a for a, _ in sources[:stripe]]
                 ):
@@ -2618,9 +2665,46 @@ class Raylet:
         self._task_plane_cache = (now, out)
         return out
 
+    async def _mesh_group_stats(self) -> Dict:
+        """Gangs this node is a member of, from the GCS mesh-group
+        registry: name -> {rank, epoch, state, steps, mesh_shape,
+        last_failure}. Cached for 2s like the task-plane fan-out; a GCS
+        without the registry (mixed-version) or mid-restart yields the
+        last cached view."""
+        ts, cached = self._mesh_group_cache
+        now = time.monotonic()
+        if now - ts < 2.0:
+            return cached
+        self._mesh_group_cache = (now, cached)  # single-flight-ish
+        out: Dict[str, Dict] = {}
+        try:
+            table = await self.gcs.call_async("mesh_group_table", None,
+                                              timeout=2)
+        except Exception:
+            return cached
+        me = self.node_id.hex()
+        for name, rec in (table or {}).items():
+            ranks = rec.get("ranks") or {}
+            if me not in ranks:
+                continue
+            out[name] = {
+                "rank": ranks[me],
+                "epoch": rec.get("epoch"),
+                "state": rec.get("state"),
+                "steps_run": rec.get("steps_run"),
+                "hosts": rec.get("hosts"),
+                "mesh_shape": rec.get("mesh_shape"),
+                "last_failure": rec.get("last_failure") or "",
+            }
+        self._mesh_group_cache = (now, out)
+        return out
+
     async def rpc_node_stats(self, conn, _):
         return {
             "node_id": self.node_id.hex(),
+            # live label view (startup labels + GCS-side patches like a
+            # MeshGroup's gang stamp) — the locality picker's inputs
+            "labels": dict(self.labels),
             "available": self.available,
             "total": self.total_resources,
             "num_workers": len(self.workers),
@@ -2632,6 +2716,9 @@ class Raylet:
             "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
             "task_plane": await self._task_plane_stats(),
+            # gang membership of this node (mesh-group compute plane):
+            # rendezvous epoch, lifecycle state, steps, last failure
+            "mesh_groups": await self._mesh_group_stats(),
             "transfer": {
                 "bytes_in": self._transfer_bytes_in,
                 "bytes_out": self._transfer_bytes_out,
@@ -2645,6 +2732,9 @@ class Raylet:
                 # in-progress pulls, pulls it rode through a tree parent,
                 # and its last assigned position in the pull registry
                 "partial_chunks_out": self._partial_chunks_out,
+                # stripe picks whose first-choice peer shared this
+                # node's host/gang label (locality-aware ordering)
+                "locality_pref_hits": self._locality_pref_hits,
                 "tree_pulls": self._tree_pulls,
                 "tree_position": self._tree_position,
                 "partial_serves_open": len(self._partial_serves),

@@ -1,0 +1,62 @@
+"""ray_tpu.mesh — gang-scheduled multi-host sharded compute.
+
+Public surface::
+
+    from ray_tpu.mesh import MeshGroup, StateKey, make_mesh
+
+    mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 4},
+                   devices_per_host=4, checkpoint_path=ckpt)
+    mg.run(init_state)                      # lays out sharded state
+    sid = mg.compile_step_with_plan(
+        train_step, in_shardings=(state_spec, batch_spec),
+        out_shardings=(state_spec, P()), donate_argnums=(0,))
+    loss, = mg.run_step(sid, StateKey("state"), batch, store={0: "state"})
+    mg.save_state(step=n)
+    # ... a rank dies: run_step raises RankFailedError for the gang ...
+    mg.recover(mesh_shape={"dp": 4, "tp": 2})   # re-place + reshard-restore
+
+``make_mesh`` is the repo's single mesh-construction code path
+(``train.session.make_mesh`` aliases it).
+"""
+
+from ray_tpu.mesh.group import (  # noqa: F401
+    BROKEN,
+    PLACING,
+    READY,
+    RENDEZVOUS,
+    SHUTDOWN,
+    MeshGroup,
+    MeshGroupError,
+    MeshWorkerContext,
+    RankFailedError,
+    StateKey,
+)
+from ray_tpu.mesh.plan import (  # noqa: F401
+    PlanError,
+    compile_step_with_plan,
+    enable_cpu_cross_process_collectives,
+    make_mesh,
+    normalize_mesh_shape,
+    set_host_platform_device_count,
+    specs_to_shardings,
+)
+
+__all__ = [
+    "MeshGroup",
+    "MeshGroupError",
+    "MeshWorkerContext",
+    "RankFailedError",
+    "StateKey",
+    "PlanError",
+    "compile_step_with_plan",
+    "make_mesh",
+    "normalize_mesh_shape",
+    "specs_to_shardings",
+    "set_host_platform_device_count",
+    "enable_cpu_cross_process_collectives",
+    "PLACING",
+    "RENDEZVOUS",
+    "READY",
+    "BROKEN",
+    "SHUTDOWN",
+]

@@ -1,0 +1,914 @@
+"""MeshGroup: gang-scheduled multi-host pjit jobs.
+
+The compute-plane composition of primitives the repo already proves in
+isolation: a STRICT_SPREAD placement group reserves one bundle per host
+(atomic gang placement), one long-lived ``_MeshWorker`` actor lands in
+each bundle, and a TCP gang rendezvous (``jax.distributed`` coordinator
+on rank 0 — the same control-plane bootstrap ``train.worker_group``
+uses) assembles every host's devices into ONE global ``jax.Mesh``.
+User step functions compile against an explicit sharding plan
+(:func:`ray_tpu.mesh.plan.compile_step_with_plan`: pjit when both
+shardings are given, ``shard_map`` fallback otherwise) and execute as
+lockstep gang calls with a single typed failure semantics: any rank
+death fails the step for the WHOLE gang (:class:`RankFailedError`).
+
+Failure/restart: :meth:`MeshGroup.recover` tears the broken gang down,
+re-places a fresh one — same or DIFFERENT ``mesh_shape``/host count —
+re-runs the rendezvous under a bumped epoch, re-compiles every
+registered step, and restores training state by RESHARDING the last
+sharded checkpoint onto the new mesh
+(``train.sharded_checkpoint.load_sharded`` slice-intersection restore),
+so a gang survives SIGKILL with a different world size.
+
+Observability: the controller publishes gang membership, rendezvous
+epoch, steps run and the last failure to the GCS mesh-group registry;
+each member node's ``node_stats`` surfaces its gangs under a
+``mesh_groups`` section, and member nodes carry a
+``raytpu.io/gang=<name>`` label that the object plane's locality-aware
+stripe-peer picker prefers (weight/checkpoint pulls stay inside the
+gang when a copy exists there).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.mesh.plan import normalize_mesh_shape
+
+logger = logging.getLogger(__name__)
+
+# gang lifecycle states (DESIGN.md "Compute plane" state machine)
+PLACING = "PLACING"
+RENDEZVOUS = "RENDEZVOUS"
+READY = "READY"
+BROKEN = "BROKEN"
+SHUTDOWN = "SHUTDOWN"
+
+
+_auto_name_seq = 0
+
+
+def _auto_name() -> str:
+    """Auto gang name: drawn from the chaos-seeded RNG (plus a process-
+    local sequence) so a replayed workload names — and therefore
+    jitters, labels and registers — its gangs identically; without a
+    chaos plane replay_rng is OS-seeded, i.e. plain unique names."""
+    from ray_tpu._private import chaos
+
+    global _auto_name_seq
+    _auto_name_seq += 1
+    rng = chaos.replay_rng(f"meshgroup:autoname:{_auto_name_seq}")
+    return f"meshgroup_{_auto_name_seq}_{rng.getrandbits(32):08x}"
+
+
+class MeshGroupError(RayTpuError):
+    """Gang-level failure (placement, rendezvous, lockstep timeout)."""
+
+
+class RankFailedError(MeshGroupError):
+    """A rank died (or its actor became unreachable) during a lockstep
+    call — the step failed for the whole gang. ``recover()`` re-places
+    and reshard-restores."""
+
+    def __init__(self, group: str, rank: int, epoch: int,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            f"mesh group {group!r}: rank {rank} failed during a lockstep "
+            f"call (rendezvous epoch {epoch}) — the step failed for the "
+            f"whole gang; call recover() to re-place and reshard-restore"
+        )
+        self.group = group
+        self.rank = rank
+        self.epoch = epoch
+        self.cause = cause
+
+
+class StateKey:
+    """Marker argument for :meth:`MeshGroup.run_step`: resolved on each
+    rank to that rank's worker-resident state entry (sharded arrays
+    never travel through the controller)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __repr__(self):
+        return f"StateKey({self.key!r})"
+
+
+class MeshWorkerContext:
+    """Per-rank view handed to ``MeshGroup.run`` functions: the global
+    mesh, this rank's coordinates, and the persistent per-rank state
+    store that ``run_step``'s StateKey args resolve against."""
+
+    def __init__(self, worker: "_MeshWorker"):
+        self.mesh = worker._mesh
+        self.rank = worker._rank
+        self.world_size = worker._world
+        self.epoch = worker._epoch
+        self.state = worker._state
+
+
+class _MeshWorker:
+    """Actor body: one per host, owns that host's devices for the gang's
+    lifetime. All methods run serially; the controller drives them in
+    lockstep across ranks."""
+
+    def __init__(self):
+        self._state: Dict[str, Any] = {}
+        self._steps: Dict[str, Callable] = {}
+        self._step_plans: Dict[str, Dict] = {}
+        self._mesh = None
+        self._rank = -1
+        self._world = 0
+        self._epoch = 0
+        self._steps_run = 0
+
+    # -- bootstrap ----------------------------------------------------
+
+    def init_runtime(self, env: Dict[str, str],
+                     n_devices: Optional[int]) -> int:
+        """Platform env + virtual-device count, pre-first-jax-import."""
+        from ray_tpu.mesh.plan import bootstrap_worker_platform
+
+        bootstrap_worker_platform(env, n_devices)
+        return 1
+
+    def coordinator_info(self) -> str:
+        from ray_tpu._private.node import node_ip_address, pick_free_port
+
+        return f"{node_ip_address()}:{pick_free_port()}"
+
+    def rendezvous(self, coordinator: str, num_processes: int,
+                   process_id: int, epoch: int,
+                   axis_names: Sequence[str],
+                   sizes: Sequence[int]) -> Dict[str, Any]:
+        """Join the gang: ``jax.distributed`` handshake over the TCP
+        control plane, then build the global mesh from the rendezvoused
+        device set."""
+        import os
+
+        import jax
+
+        from ray_tpu.mesh.plan import (
+            enable_cpu_cross_process_collectives,
+            make_mesh,
+        )
+
+        if num_processes > 1:
+            # env check, NOT jax.default_backend(): probing the backend
+            # would initialize it before jax.distributed, collapsing the
+            # world to this process's devices
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                enable_cpu_cross_process_collectives()
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        self._mesh = make_mesh(
+            dict(zip(axis_names, sizes)), axis_names=tuple(axis_names)
+        )
+        self._rank = process_id
+        self._world = num_processes
+        self._epoch = epoch
+        return {
+            "node_id": ray_tpu.get_runtime_context().get_node_id(),
+            "pid": os.getpid(),
+            "local_devices": jax.local_device_count(),
+            "global_devices": jax.device_count(),
+            "process_index": jax.process_index(),
+        }
+
+    # -- gang work ----------------------------------------------------
+
+    def run(self, fn: Callable, args: Tuple, kwargs: Dict) -> Any:
+        """Execute ``fn(ctx, *args, **kwargs)`` on this rank."""
+        return fn(MeshWorkerContext(self), *args, **(kwargs or {}))
+
+    def compile_step(self, step_id: str, fn: Callable,
+                     plan: Dict[str, Any]) -> int:
+        from ray_tpu.mesh.plan import compile_step_with_plan
+
+        self._steps[step_id] = compile_step_with_plan(
+            fn, self._mesh, **plan
+        )
+        self._step_plans[step_id] = plan
+        return 1
+
+    def _globalize_args(self, step_id: str, argv: List) -> List:
+        """Turn broadcast host values (numpy/scalars — identical on
+        every rank by construction: the controller ships one copy to
+        all) into GLOBAL ``jax.Array``s laid out per the step's input
+        plan. Multi-process pjit refuses raw host inputs; each rank
+        provides whatever slices of the (identical) host value its
+        devices own. Args that are already ``jax.Array`` (StateKey
+        resolutions, prior outputs) pass through untouched."""
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec, Sharding
+
+        plan = self._step_plans.get(step_id) or {}
+        in_tree = plan.get("in_shardings")
+        if in_tree is None:
+            in_tree = plan.get("in_specs")
+        if not isinstance(in_tree, (tuple, list)) or len(in_tree) != len(
+            argv
+        ):
+            return argv
+
+        def is_spec(x):
+            return isinstance(x, (PartitionSpec, Sharding))
+
+        def convert(spec, x):
+            if isinstance(x, jax.Array):
+                return x
+            arr = np.asarray(x)
+            sh = spec if isinstance(spec, Sharding) else NamedSharding(
+                self._mesh, spec
+            )
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx: arr[idx]
+            )
+
+        out = []
+        for spec_i, a in zip(in_tree, argv):
+            try:
+                # structure probe only — a mismatched arg/spec tree is
+                # handed through untouched (the user passed their own
+                # layout); conversion errors (shape vs spec) must NOT
+                # be swallowed as if they were structure mismatches
+                jax.tree.map(lambda *_: None, spec_i, a, is_leaf=is_spec)
+            except ValueError:
+                out.append(a)
+                continue
+            out.append(jax.tree.map(convert, spec_i, a, is_leaf=is_spec))
+        return out
+
+    def run_step(self, step_id: str, args: Tuple,
+                 store: Optional[Dict[int, str]],
+                 fetch: Optional[List[int]]) -> List:
+        """One lockstep execution of a compiled step.
+
+        StateKey args resolve to this rank's state entries; outputs
+        listed in ``store`` stay worker-resident (sharded training
+        state never leaves the devices); ``fetch`` indices come back as
+        host numpy (they must be replicated outputs — every rank
+        returns the same values)."""
+        import jax
+        import numpy as np
+
+        step = self._steps.get(step_id)
+        if step is None:
+            raise MeshGroupError(f"unknown step {step_id!r} on rank "
+                                 f"{self._rank} (compile before run)")
+        argv = [
+            self._state[a.key] if isinstance(a, StateKey) else a
+            for a in args
+        ]
+        out = step(*self._globalize_args(step_id, argv))
+        outs = out if isinstance(out, tuple) else (out,)
+        store = {int(k): v for k, v in (store or {}).items()}
+        for idx, key in store.items():
+            self._state[key] = outs[idx]
+        if fetch is None:
+            fetch = [i for i in range(len(outs)) if i not in store]
+        self._steps_run += 1
+        return [np.asarray(jax.device_get(outs[int(i)])) for i in fetch]
+
+    def save_state(self, path: str, step: int,
+                   keys: Optional[List[str]]) -> int:
+        """Sharded checkpoint of the named state entries (every rank
+        writes only the shards it holds; rank 0 commits)."""
+        from ray_tpu.train.sharded_checkpoint import save_sharded
+
+        keys = list(keys) if keys else sorted(self._state)
+        tree = {k: self._state[k] for k in keys}
+        save_sharded(tree, path, step=step, wait=True)
+        return step
+
+    def restore_state(self, path: str,
+                      keys: Optional[List[str]]) -> int:
+        """Reshard-restore the named entries from a sharded checkpoint
+        onto THIS gang's mesh (slice-intersection reassembly — the
+        checkpoint may come from a different mesh shape/world size).
+        The entries must already exist (state_init ran) so their
+        shardings define the restore layout."""
+        from ray_tpu.train.sharded_checkpoint import (
+            checkpoint_step,
+            load_sharded,
+        )
+
+        keys = list(keys) if keys else sorted(self._state)
+        if not keys:
+            raise MeshGroupError(
+                f"rank {self._rank}: no state entries to restore into — "
+                f"run the state init first (restoring into empty state "
+                f"would silently restore nothing)"
+            )
+        template = {k: self._state[k] for k in keys}
+        restored = load_sharded(path, like=template)
+        for k in keys:
+            self._state[k] = restored[k]
+        return checkpoint_step(path)
+
+    def stats(self) -> Dict[str, int]:
+        return {"rank": self._rank, "steps_run": self._steps_run,
+                "epoch": self._epoch}
+
+
+class MeshGroup:
+    """Controller handle on a gang of one ``_MeshWorker`` per host.
+
+    ``mesh_shape`` is an ordered ``{axis: size}`` dict (or a
+    ``parallel.mesh.MeshConfig``); its product must equal
+    ``hosts * devices_per_host``. The constructor blocks until the gang
+    is placed, rendezvoused and READY.
+    """
+
+    def __init__(
+        self,
+        hosts: int,
+        mesh_shape,
+        axis_names: Optional[Sequence[str]] = None,
+        *,
+        devices_per_host: Optional[int] = None,
+        name: Optional[str] = None,
+        resources_per_host: Optional[Dict[str, float]] = None,
+        env: Optional[Dict[str, str]] = None,
+        checkpoint_path: Optional[str] = None,
+        state_init: Optional[Callable] = None,
+    ):
+        if hosts < 1:
+            raise ValueError("hosts must be >= 1")
+        self.name = name or _auto_name()
+        self.hosts = hosts
+        self.axis_names, self.sizes = normalize_mesh_shape(
+            mesh_shape, axis_names
+        )
+        self.devices_per_host = devices_per_host
+        self.resources_per_host = dict(resources_per_host or {"CPU": 1.0})
+        self.env = dict(env or {})
+        self.checkpoint_path = checkpoint_path
+        self.state_init = state_init
+        self.state = PLACING
+        self.epoch = 0
+        self.steps_run = 0
+        self.last_failure = ""
+        self.pg = None
+        self.workers: List = []
+        self.members: List[Dict] = []  # rendezvous replies, rank order
+        self._registry_quiet_until = 0.0  # periodic-publish cooldown
+        # (fn, plan) per compiled step — recover() recompiles these on
+        # the fresh gang
+        self._step_registry: Dict[str, Tuple[Callable, Dict]] = {}
+        self._validate_shape()
+        try:
+            self._bring_up(attempts=3)
+        except BaseException:
+            # the gang never existed publicly: a constructor failure
+            # must not leave a BROKEN orphan in the registry (a caller
+            # retrying in a loop would grow one per attempt)
+            self._teardown(note="init failed")
+            self._gcs_call("mesh_group_remove", self.name)
+            raise
+
+    # ------------------------------------------------------------------
+
+    def _bring_up(self, attempts: int = 3):
+        """Place + rendezvous with bounded, jittered retries: transient
+        cluster weather (a node falsely declared dead under chaos, a
+        host lost between the 2PC reservation and worker boot) costs a
+        re-place, not the gang. Jitter draws from the chaos-seeded RNG
+        so a replayed fault schedule meets identical re-placement
+        traffic. Exhaustion leaves the gang BROKEN and raises."""
+        from ray_tpu._private import chaos
+
+        rng = chaos.replay_rng(f"meshgroup:{self.name}:bring_up")
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, attempts)):
+            try:
+                self._place()
+                self._rendezvous()
+                return
+            except Exception as e:
+                # not just MeshGroupError: a dying host surfaces as an
+                # actor/task error — exactly the transient class this
+                # loop exists for; teardown so nothing leaks between
+                # attempts
+                last = e
+                self._teardown(note=f"bring-up attempt {attempt} failed")
+                time.sleep((0.2 + 0.3 * attempt) * (1 + rng.random()))
+        self.state = BROKEN
+        self.last_failure = f"bring-up failed: {last}"
+        self._publish_registry()
+        raise MeshGroupError(
+            f"mesh group {self.name!r}: gang bring-up exhausted "
+            f"{attempts} placement attempt(s): {last}"
+        ) from last
+
+    def _validate_shape(self):
+        total = math.prod(self.sizes)
+        if self.devices_per_host is not None:
+            want = self.hosts * self.devices_per_host
+            if total != want:
+                raise MeshGroupError(
+                    f"mesh {dict(zip(self.axis_names, self.sizes))} has "
+                    f"{total} devices but hosts x devices_per_host = "
+                    f"{want}"
+                )
+
+    def _place(self):
+        """Gang-reserve one bundle per host (STRICT_SPREAD 2PC), then pin
+        worker i into bundle i — atomic multi-host placement."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.util.placement_group import placement_group
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        self.state = PLACING
+        timeout = GLOBAL_CONFIG.mesh_group_placement_timeout_s
+        self.pg = placement_group(
+            [dict(self.resources_per_host) for _ in range(self.hosts)],
+            strategy="STRICT_SPREAD",
+            name=f"mesh:{self.name}",
+        )
+        if not self.pg.wait(timeout_seconds=timeout):
+            raise MeshGroupError(
+                f"mesh group {self.name!r}: STRICT_SPREAD placement of "
+                f"{self.hosts} bundle(s) {self.resources_per_host} did "
+                f"not complete within {timeout}s — not enough distinct "
+                f"feasible hosts?"
+            )
+        opts = {"resources": dict(self.resources_per_host),
+                "max_restarts": 0}
+        if self.resources_per_host.get("TPU"):
+            opts["num_tpus"] = self.resources_per_host["TPU"]
+        actor_cls = ray_tpu.remote(**opts)(_MeshWorker)
+        self.workers = [
+            actor_cls.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    self.pg, placement_group_bundle_index=i
+                )
+            ).remote()
+            for i in range(self.hosts)
+        ]
+        ray_tpu.get(
+            [w.init_runtime.remote(self.env, self.devices_per_host)
+             for w in self.workers],
+            timeout=timeout,
+        )
+
+    def _rendezvous(self):
+        """Assemble the global JAX world under a new epoch and build the
+        gang's mesh on every rank."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self.state = RENDEZVOUS
+        self.epoch += 1
+        timeout = GLOBAL_CONFIG.mesh_group_rendezvous_timeout_s
+        coordinator = ""
+        if self.hosts > 1:
+            coordinator = ray_tpu.get(
+                self.workers[0].coordinator_info.remote(), timeout=60
+            )
+        self.members = self._gang_call(
+            [
+                w.rendezvous.remote(
+                    coordinator, self.hosts, i, self.epoch,
+                    list(self.axis_names), list(self.sizes),
+                )
+                for i, w in enumerate(self.workers)
+            ],
+            timeout=timeout,
+            what="rendezvous",
+        )
+        total = math.prod(self.sizes)
+        seen = self.members[0]["global_devices"]
+        if seen != total:
+            raise MeshGroupError(
+                f"mesh group {self.name!r}: rendezvous saw {seen} global "
+                f"devices, mesh {dict(zip(self.axis_names, self.sizes))} "
+                f"needs {total}"
+            )
+        node_ids = [m["node_id"] for m in self.members]
+        if len(set(node_ids)) != self.hosts:
+            raise MeshGroupError(
+                f"mesh group {self.name!r}: gang is not one-per-host "
+                f"({node_ids})"
+            )
+        self.state = READY
+        self._publish_registry()
+        self._stamp_gang_labels(node_ids)
+
+    # -- lockstep machinery --------------------------------------------
+
+    def _gang_call(self, refs: List, timeout: float, what: str) -> List:
+        """Gather one lockstep call across all ranks. Any rank's failure
+        (actor death first among them) breaks the WHOLE gang: survivors
+        may be wedged inside the dead rank's collective, so they are
+        torn down rather than awaited."""
+        deadline = time.monotonic() + timeout
+        remaining = list(enumerate(refs))
+        results: List[Any] = [None] * len(refs)
+        failures: Dict[int, BaseException] = {}
+        while remaining:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            ready, _ = ray_tpu.wait(
+                [r for _, r in remaining],
+                num_returns=len(remaining),
+                timeout=min(1.0, budget),
+            )
+            ready_set = set(ready)
+            still = []
+            for rank, ref in remaining:
+                if ref in ready_set:
+                    try:
+                        results[rank] = ray_tpu.get(ref, timeout=60)
+                    except Exception as e:  # rank death / typed task error
+                        failures[rank] = e
+                else:
+                    still.append((rank, ref))
+            remaining = still
+            if failures:
+                break
+        if failures:
+            # A dead rank's peers often fail FIRST (their collective
+            # aborts before the raylet reports the death): sweep the
+            # still-pending refs for a short grace so the error
+            # attributes to the rank that actually died, not the first
+            # survivor that felt it.
+            grace = time.monotonic() + 2.0
+            while remaining and time.monotonic() < grace:
+                ready, _ = ray_tpu.wait(
+                    [r for _, r in remaining],
+                    num_returns=len(remaining), timeout=0.5,
+                )
+                ready_set = set(ready)
+                still = []
+                for rank, ref in remaining:
+                    if ref in ready_set:
+                        try:
+                            results[rank] = ray_tpu.get(ref, timeout=10)
+                        except Exception as e:
+                            failures[rank] = e
+                    else:
+                        still.append((rank, ref))
+                remaining = still
+            from ray_tpu.exceptions import (
+                ActorDiedError,
+                ActorUnavailableError,
+                WorkerCrashedError,
+            )
+
+            dead = [
+                r for r, e in sorted(failures.items())
+                if isinstance(e, (ActorDiedError, ActorUnavailableError,
+                                  WorkerCrashedError))
+            ]
+            rank = dead[0] if dead else min(failures)
+            self._break_gang(f"{what}: rank {rank} failed: "
+                             f"{failures[rank]!r}")
+            raise RankFailedError(
+                self.name, rank, self.epoch, cause=failures[rank]
+            ) from failures[rank]
+        if remaining:
+            ranks = sorted(r for r, _ in remaining)
+            self._break_gang(
+                f"{what}: ranks {ranks} did not complete in {timeout}s"
+            )
+            raise MeshGroupError(
+                f"mesh group {self.name!r}: lockstep {what} timed out "
+                f"after {timeout}s waiting on ranks {ranks} — the gang "
+                f"is broken; call recover()"
+            )
+        return results
+
+    def _break_gang(self, why: str):
+        self.state = BROKEN
+        self.last_failure = why
+        logger.warning("mesh group %s broken: %s", self.name, why)
+        # keep the broken incarnation's membership visible: teardown
+        # clears self.members (labels, actors), but the registry record
+        # must still name the members/ranks so their node_stats surface
+        # BROKEN + last_failure where operators look
+        members = list(self.members)
+        self._teardown(note=why, keep_registry=True)
+        self.members = members
+        self._publish_registry()
+
+    def _require_ready(self):
+        if self.state != READY:
+            why = f" ({self.last_failure})" if self.last_failure else ""
+            hint = " — call recover()" if self.state == BROKEN else ""
+            raise MeshGroupError(
+                f"mesh group {self.name!r} is {self.state}{why}{hint}"
+            )
+
+    # -- public gang API ----------------------------------------------
+
+    def run(self, fn: Callable, *args, timeout: Optional[float] = None,
+            **kwargs) -> List:
+        """Lockstep-run ``fn(ctx, *args, **kwargs)`` on every rank;
+        returns per-rank results in rank order."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._require_ready()
+        return self._gang_call(
+            [w.run.remote(fn, args, kwargs) for w in self.workers],
+            timeout=timeout or GLOBAL_CONFIG.mesh_group_step_timeout_s,
+            what="run",
+        )
+
+    def compile_step_with_plan(
+        self,
+        fn: Callable,
+        *,
+        in_shardings=None,
+        out_shardings=None,
+        donate_argnums: Sequence[int] = (),
+        static_argnums: Sequence[int] = (),
+        in_specs=None,
+        out_specs=None,
+        step_id: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> str:
+        """Compile ``fn`` against the sharding plan on EVERY rank (pjit
+        when both shardings are given, shard_map fallback over
+        in_specs/out_specs otherwise). Returns the step id for
+        :meth:`run_step`. The plan is registered controller-side so
+        :meth:`recover` can recompile it on a fresh gang."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._require_ready()
+        step_id = step_id or f"step_{len(self._step_registry)}"
+        plan = {
+            "in_shardings": in_shardings,
+            "out_shardings": out_shardings,
+            "donate_argnums": tuple(donate_argnums),
+            "static_argnums": tuple(static_argnums),
+            "in_specs": in_specs,
+            "out_specs": out_specs,
+        }
+        self._gang_call(
+            [w.compile_step.remote(step_id, fn, plan)
+             for w in self.workers],
+            timeout=timeout or GLOBAL_CONFIG.mesh_group_step_timeout_s,
+            what=f"compile:{step_id}",
+        )
+        self._step_registry[step_id] = (fn, plan)
+        return step_id
+
+    def run_step(self, step_id: str, *args,
+                 store: Optional[Dict[int, str]] = None,
+                 fetch: Optional[List[int]] = None,
+                 timeout: Optional[float] = None) -> List:
+        """Gang-coherent dispatch of one compiled step: all ranks execute
+        it as one lockstep call. ``StateKey`` args resolve per rank;
+        ``store={output_index: state_key}`` keeps those outputs
+        worker-resident; ``fetch`` indices return as host numpy (rank
+        0's copy — fetched outputs must be replicated). Any rank death
+        raises :class:`RankFailedError` for the whole gang."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._require_ready()
+        out = self._gang_call(
+            [w.run_step.remote(step_id, args, store, fetch)
+             for w in self.workers],
+            timeout=timeout or GLOBAL_CONFIG.mesh_group_step_timeout_s,
+            what=f"step:{step_id}",
+        )
+        self.steps_run += 1
+        if self.steps_run % 16 == 0:  # keep the registry's counter warm
+            self._publish_registry_periodic()
+        return out[0]
+
+    def save_state(self, path: Optional[str] = None, *, step: int = 0,
+                   keys: Optional[List[str]] = None,
+                   timeout: Optional[float] = None) -> str:
+        """Sharded-checkpoint the gang's worker-resident state (every
+        rank writes its shards, rank 0 commits). Returns the path."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        path = path or self.checkpoint_path
+        if not path:
+            raise MeshGroupError("no checkpoint path configured")
+        self._require_ready()
+        self._gang_call(
+            [w.save_state.remote(path, step, keys) for w in self.workers],
+            timeout=timeout or GLOBAL_CONFIG.mesh_group_step_timeout_s,
+            what="save_state",
+        )
+        return path
+
+    def restore_state(self, path: Optional[str] = None, *,
+                      keys: Optional[List[str]] = None,
+                      timeout: Optional[float] = None) -> int:
+        """Reshard-restore state from a sharded checkpoint onto the
+        CURRENT mesh (any source mesh shape). Returns the checkpoint
+        step."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        path = path or self.checkpoint_path
+        if not path:
+            raise MeshGroupError("no checkpoint path configured")
+        self._require_ready()
+        out = self._gang_call(
+            [w.restore_state.remote(path, keys) for w in self.workers],
+            timeout=timeout or GLOBAL_CONFIG.mesh_group_step_timeout_s,
+            what="restore_state",
+        )
+        return out[0]
+
+    # -- failure recovery ----------------------------------------------
+
+    def recover(self, mesh_shape=None, *, hosts: Optional[int] = None,
+                devices_per_host: Optional[int] = None,
+                state_init: Optional[Callable] = None,
+                restore_from: Optional[str] = None,
+                attempts: int = 3) -> Optional[int]:
+        """Rebuild a broken (or live) gang and resume from the last
+        sharded checkpoint.
+
+        Tears the old gang down, re-places — optionally onto a NEW
+        ``mesh_shape`` / ``hosts`` (shrink or grow) — re-runs the gang
+        rendezvous under a bumped epoch, re-compiles every registered
+        step, re-runs ``state_init`` to lay out fresh state on the new
+        mesh, and reshard-restores the checkpoint onto it. Returns the
+        restored checkpoint step (None when there was nothing to
+        restore). Placement retries (``_bring_up``) jitter from the
+        chaos-seeded RNG so replayed fault schedules meet identical
+        re-placement traffic.
+        """
+        if mesh_shape is not None:
+            self.axis_names, self.sizes = normalize_mesh_shape(
+                mesh_shape, None if isinstance(mesh_shape, dict)
+                else self.axis_names
+            )
+        if hosts is not None:
+            self.hosts = hosts
+        if devices_per_host is not None:
+            self.devices_per_host = devices_per_host
+        init = state_init or self.state_init
+        path = restore_from or self.checkpoint_path
+        self._validate_shape()
+        self._teardown(note="recovering")
+        self._bring_up(attempts=attempts)
+        for step_id, (fn, plan) in self._step_registry.items():
+            self._gang_call(
+                [w.compile_step.remote(step_id, fn, plan)
+                 for w in self.workers],
+                timeout=120.0, what=f"recompile:{step_id}",
+            )
+        if init is not None:
+            self.run(init)
+        restored = None
+        if path:
+            from ray_tpu.train.sharded_checkpoint import is_committed
+
+            if is_committed(path):
+                if init is None:
+                    # fresh ranks have EMPTY state: restoring into it
+                    # would silently restore nothing — the target
+                    # shardings must exist first
+                    self.state = BROKEN
+                    self.last_failure = "recover: no state_init"
+                    self._publish_registry()
+                    raise MeshGroupError(
+                        f"mesh group {self.name!r}: a committed "
+                        f"checkpoint exists at {path} but no state_init "
+                        f"is configured — recover() needs it (or pass "
+                        f"state_init=) to lay out the target shardings "
+                        f"the reshard-restore loads into"
+                    )
+                restored = self.restore_state(path)
+        self.last_failure = ""
+        self._publish_registry()
+        return restored
+
+    # -- observability / lifecycle -------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "state": self.state,
+            "epoch": self.epoch,
+            "hosts": self.hosts,
+            "mesh_shape": dict(zip(self.axis_names, self.sizes)),
+            "steps_run": self.steps_run,
+            "members": [m.get("node_id") for m in self.members],
+            "last_failure": self.last_failure,
+        }
+
+    def _registry_record(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "state": self.state,
+            "epoch": self.epoch,
+            "hosts": self.hosts,
+            "mesh_shape": dict(zip(self.axis_names, self.sizes)),
+            "axis_names": list(self.axis_names),
+            "steps_run": self.steps_run,
+            "members": [m.get("node_id") for m in self.members],
+            "ranks": {m.get("node_id"): i
+                      for i, m in enumerate(self.members)},
+            "last_failure": self.last_failure,
+        }
+
+    def _gcs_call(self, method: str, payload,
+                  timeout: float = 10.0) -> Any:
+        """Best-effort GCS registry traffic: a mixed-version GCS without
+        the mesh registry must not fail gang work."""
+        from ray_tpu._private.worker import require_connected
+
+        try:
+            return require_connected().gcs.call(method, payload,
+                                                timeout=timeout)
+        except Exception as e:
+            logger.debug("mesh registry %s skipped: %r", method, e)
+            return None
+
+    def _publish_registry(self):
+        self._gcs_call("mesh_group_update", self._registry_record())
+
+    def _publish_registry_periodic(self):
+        """Steps-counter refresh from the run_step hot path: pure
+        observability, so it gets a SHORT timeout and a cooldown after
+        a failure — a GCS mid-restart must cost lockstep training at
+        most one 2s stall per 30s, not 10s every 16 steps."""
+        now = time.monotonic()
+        if now < self._registry_quiet_until:
+            return
+        ok = self._gcs_call("mesh_group_update", self._registry_record(),
+                            timeout=2)
+        self._registry_quiet_until = 0.0 if ok else now + 30.0
+
+    def _stamp_gang_labels(self, node_ids: List[str], clear: bool = False):
+        from ray_tpu._private.protocol import LABEL_GANG
+
+        for nid in node_ids:
+            if clear:
+                # compare-and-clear: a teardown running off a stale
+                # member list (the node was freed and a successor gang
+                # stamped it) must not wipe the successor's label
+                self._gcs_call(
+                    "update_node_labels",
+                    [bytes.fromhex(nid), {LABEL_GANG: None},
+                     {LABEL_GANG: self.name}],
+                )
+            else:
+                self._gcs_call(
+                    "update_node_labels",
+                    [bytes.fromhex(nid), {LABEL_GANG: self.name}],
+                )
+
+    def _teardown(self, note: str = "", keep_registry: bool = False):
+        """Release actors + bundles (and clear gang labels). State and
+        registry handling is the caller's job."""
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.members:
+            try:
+                self._stamp_gang_labels(
+                    [m["node_id"] for m in self.members], clear=True
+                )
+            except Exception:
+                pass
+        self.members = []
+        if self.pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
+        if not keep_registry and note:
+            logger.debug("mesh group %s teardown: %s", self.name, note)
+
+    def shutdown(self):
+        """Kill the gang, release the placement group, drop the registry
+        entry and gang labels."""
+        self._teardown(note="shutdown")
+        self.state = SHUTDOWN
+        self._gcs_call("mesh_group_remove", self.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

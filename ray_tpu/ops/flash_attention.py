@@ -384,7 +384,9 @@ def flash_attention_sharded(
         )
     spec = jax.sharding.PartitionSpec(dp_axis, None, tp_axis, None)
     kv_spec = spec
-    return jax.shard_map(
+    from ray_tpu.mesh.plan import get_shard_map
+
+    return get_shard_map()(
         functools.partial(flash_attention, **kw),
         mesh=mesh,
         in_specs=(spec, kv_spec, kv_spec),

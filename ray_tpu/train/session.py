@@ -130,11 +130,17 @@ def make_mesh(mesh_config=None):
 
     Call after the worker group's ``jax.distributed`` bootstrap: sees every
     process's devices, factored per the ScalingConfig's MeshConfig.
+
+    Thin alias onto :func:`ray_tpu.mesh.make_mesh` — the repo's single
+    mesh-construction code path (MeshGroup gangs build theirs through
+    the same function); this wrapper only supplies the session's
+    MeshConfig default.
     """
-    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.mesh import make_mesh as _make_mesh
+    from ray_tpu.parallel.mesh import MeshConfig
 
     cfg = mesh_config or _get_session().context.mesh_config or MeshConfig()
-    return build_mesh(cfg)
+    return _make_mesh(cfg)
 
 
 def distribute_batch(batch, mesh, spec=None):

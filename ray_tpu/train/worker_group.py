@@ -34,17 +34,11 @@ class _TrainWorker:
 
     def init_runtime(self, env: Dict[str, str],
                      n_virtual_devices: Optional[int]) -> int:
-        """Apply platform env before this process first initializes jax."""
-        import os
+        """Apply platform env before this process first initializes jax
+        (shared bootstrap: ray_tpu.mesh.plan.bootstrap_worker_platform)."""
+        from ray_tpu.mesh.plan import bootstrap_worker_platform
 
-        os.environ.update(env)
-        import jax
-
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            # the axon site hook pins jax_platforms; force it back for sim
-            jax.config.update("jax_platforms", "cpu")
-        if n_virtual_devices:
-            jax.config.update("jax_num_cpu_devices", n_virtual_devices)
+        bootstrap_worker_platform(env, n_virtual_devices)
         return 1
 
     def coordinator_info(self) -> str:
@@ -54,9 +48,19 @@ class _TrainWorker:
 
     def setup_distributed(self, coordinator: str, num_processes: int,
                           process_id: int) -> Dict[str, int]:
+        import os
+
         import jax
 
         if num_processes > 1:
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                # default XLA CPU client refuses cross-process programs;
+                # gloo collectives make the simulated pod run real SPMD
+                from ray_tpu.mesh.plan import (
+                    enable_cpu_cross_process_collectives,
+                )
+
+                enable_cpu_cross_process_collectives()
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=num_processes,

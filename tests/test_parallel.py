@@ -242,7 +242,9 @@ def test_in_graph_collective_verbs():
         gathered = in_graph.allgather(x, "dp")
         return total, gathered
 
-    total, gathered = jax.shard_map(
+    from ray_tpu.mesh.plan import get_shard_map
+
+    total, gathered = get_shard_map()(
         body, mesh=mesh, in_specs=P("dp"),
         out_specs=(P(), P("dp", None)), check_vma=False,
     )(xs)

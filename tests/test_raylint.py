@@ -241,6 +241,36 @@ def test_r4_covers_serve_router_randomness():
     assert findings == []
 
 
+def test_r4_covers_mesh_package_randomness():
+    """R4's module prong extends to the whole ``ray_tpu/mesh/``
+    directory (r10): gang re-placement/rendezvous retry jitter is
+    traffic a replayed chaos schedule must meet again, so mesh-package
+    code may only draw from ``chaos.replay_rng`` — OS-seeded ``random``
+    draws anywhere under the directory are findings."""
+    bad = textwrap.dedent(
+        """
+        import random
+        def _recover_backoff(self, attempt):
+            return (0.2 + 0.3 * attempt) * (1 + random.random())
+        """
+    )
+    findings, _ = lint_source(bad, "ray_tpu/mesh/group.py")
+    assert any(f.rule == "R4" for f in findings)
+    # same code OUTSIDE the directory (and off the basename list): clean
+    findings, _ = lint_source(bad, "ray_tpu/train/worker_group.py")
+    assert findings == []
+    good = textwrap.dedent(
+        """
+        from ray_tpu._private import chaos
+        def _recover_backoff(self, attempt):
+            rng = chaos.replay_rng("meshgroup:recover")
+            return (0.2 + 0.3 * attempt) * (1 + rng.random())
+        """
+    )
+    findings, _ = lint_source(good, "ray_tpu/mesh/group.py")
+    assert findings == []
+
+
 def test_suppression_by_rule_name_and_def_line():
     path, bad, _ = CORPUS["R1"]
     src = textwrap.dedent(bad).replace(

@@ -31,7 +31,9 @@ R6 swallowed-cancellation  ``asyncio.CancelledError`` must propagate out
                            shut down (bare ``except:`` swallows it).
 
 Scoping: R1 applies to files under a ``_private/`` directory; R3 and the
-module prong of R4 apply to the wire/control modules by basename; the
+module prong of R4 apply to the wire/control modules by basename (R4
+additionally to whole directories in ``_R4_DIRS`` — ``ray_tpu/mesh``,
+whose re-placement/rendezvous jitter is chaos-replayed); the
 docstring prong of R4 applies anywhere a function's docstring declares
 determinism ("deterministic", "replayable", "byte-identical",
 "pure function", "chaos-replay" — the repo convention these checkers
@@ -75,6 +77,11 @@ _R3_FILES = {"rpc.py", "conduit_rpc.py", "raylet.py"}
 #: chaos.replay_rng, never the OS-seeded random module.
 _R4_FILES = {"chaos.py", "rpc.py", "conduit_rpc.py", "raylet.py", "gcs.py",
              "router.py"}
+#: Whole directories under R4's module prong (matched as a path
+#: segment). ray_tpu/mesh joined in r10: gang re-placement/rendezvous
+#: retry jitter is replayed by chaos schedules — it draws from
+#: chaos.replay_rng, never the OS-seeded random module.
+_R4_DIRS = {"mesh"}
 
 #: R4: draws on the process-global (OS-seeded) random module.
 _R4_DRAWS = {
@@ -270,7 +277,10 @@ def _check_r3(tree: ast.AST, path: str, func_of,
 def _check_r4(tree: ast.AST, path: str, aliases,
               findings: List[Finding]):
     base = os.path.basename(path)
-    module_scope = base in _R4_FILES
+    segments = path.replace(os.sep, "/").split("/")
+    module_scope = base in _R4_FILES or bool(
+        _R4_DIRS.intersection(segments[:-1])
+    )
     for fn in ast.walk(tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
