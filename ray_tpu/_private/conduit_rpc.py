@@ -141,6 +141,14 @@ class ConduitConnection:
         # callback (no handler task) — rpc.Connection.sync_notify parity
         # for outbound conduit conns (task_done / task_done_batch)
         self.sync_notify: Dict[str, Callable] = {}
+        # method -> fn(conn, data) -> bool: REAPER-THREAD notify fast
+        # path, consulted before the coalesced loop hop. A handler that
+        # returns True consumed the frame entirely on the reaper thread
+        # (the sync-RTT latency path: a singleton task_done resolves
+        # the blocked caller one thread-hop earlier); False falls
+        # through to the normal sync_notify dispatch. Handlers here
+        # must be thread-safe against the loop.
+        self.sync_notify_fast: Dict[str, Callable] = {}
         # reaper->loop hop coalescing for sync notifies: asyncio's
         # call_soon_threadsafe writes the self-pipe EVERY call, so a
         # completion-frame burst would pay one wakeup syscall per frame;
@@ -438,6 +446,17 @@ class ConduitConnection:
         if fast is not None and fast(self, kind, seqno, method, data):
             return
         if kind == rpc._NOTIFY:
+            ff = self.sync_notify_fast.get(method)
+            if ff is not None:
+                try:
+                    if ff(self, data):
+                        return  # consumed on the reaper thread
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "fast notify handler failed on %s", self.name
+                    )
             fn = self.sync_notify.get(method)
             if fn is not None:
                 # coalesced hop to the loop, no handler task — the

@@ -162,6 +162,24 @@ _d("native_push_conns", bool, True)
 # Bigger returns are store-backed ("p" element). 0 disables inlining
 # (every return store-backed — the legacy/interop fallback shape).
 _d("task_inline_return_bytes", int, 64 * 1024)
+# latency-shaped completion fast path (r11): a SINGLETON task_done
+# (one-completion batch — the sync round-trip shape) resolves the
+# return entry directly on the conduit reaper thread, skipping the
+# coalesced reaper->loop wakeup the batched throughput path pays; the
+# blocked caller wakes one thread-hop earlier. Bursty batches (>1
+# completion/frame) keep the coalesced loop path. Disable to force
+# every completion through the loop (debugging/interop testing).
+_d("task_done_reaper_fastpath", bool, True)
+# submit-leg twin of the above: a lone ordered-actor call on a warm
+# streamed connection (empty queue, no pump in flight, plain args,
+# free window credit) pushes its frame straight from the CALLER
+# thread — no IO-loop wakeup on the submit leg at all. Bursts still
+# ride the corked pump (the throughput path).
+_d("actor_direct_submit", bool, True)
+# raylet-side GCS read cache: object-location entries kept (LRU-ish
+# bounded; populate-on-read, invalidated by the "locs" pubsub channel).
+# 0 disables the cache (every pull round-trips the GCS directory).
+_d("raylet_loc_cache_entries", int, 4096)
 # conduit reap-queue high-water mark: past this many MB of unreaped
 # frames the engine stops reading sockets (bounded memory under a
 # stalled reaper; backpressure propagates to senders' queues)
@@ -208,6 +226,14 @@ _d("client_retry_window_s", float, 20.0)
 # fsync the GCS mutation journal per append (SIGKILL survival needs only
 # the write() -> page cache; fsync buys power-loss durability at ~ms/op)
 _d("gcs_journal_fsync", bool, False)
+# journal GROUP COMMIT (r11): mutations buffered within one event-loop
+# tick land as ONE write+flush (+one fsync) batch; replies defer until
+# the covering flush, so durable-at-ack is preserved. batch_max forces
+# an immediate flush at that depth (1 = the legacy per-record shape);
+# flush_interval_s > 0 trades mutation-ack latency for deeper batches
+# (0 = flush at the end of the current tick).
+_d("gcs_journal_batch_max", int, 256)
+_d("gcs_journal_flush_interval_s", float, 0.0)
 # after a journal-restored GCS boots, how long raylets get to re-register
 # and reclaim their live actors before unclaimed ones are re-placed
 _d("gcs_actor_recovery_grace_s", float, 10.0)

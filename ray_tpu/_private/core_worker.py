@@ -117,6 +117,68 @@ class _StorePin:
         self.release_now()
 
 
+class _ActorWindow:
+    """Thread-safe pipeline-window credits for one actor (r11 —
+    replaces the asyncio.Semaphore): the conduit reaper thread releases
+    a slot with NO loop hop when nothing is parked (the sync-RTT
+    shape), and the caller-thread direct-submit path claims one without
+    entering the loop. Parked acquirers (the pump at full depth) are
+    loop futures woken via call_soon_threadsafe — the throughput path
+    pays the hop only when the window is actually contended."""
+
+    __slots__ = ("_credits", "_lock", "_waiters", "_loop")
+
+    def __init__(self, credits: int, loop):
+        self._credits = credits
+        self._lock = threading.Lock()
+        self._waiters: collections.deque = collections.deque()
+        self._loop = loop
+
+    def try_acquire(self) -> bool:
+        """Non-blocking claim; any thread."""
+        with self._lock:
+            if self._credits > 0:
+                self._credits -= 1
+                return True
+            return False
+
+    def available(self) -> bool:
+        return self._credits > 0
+
+    async def acquire(self):
+        """Loop-side claim; parks until a release hands over a slot."""
+        fut = None
+        with self._lock:
+            if self._credits > 0:
+                self._credits -= 1
+                return
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+        await fut
+
+    def release(self):
+        """Return a slot; any thread. A parked acquirer gets the slot
+        handed over directly (credit never goes re-claimable in
+        between, so FIFO order holds for the pump)."""
+        wake = None
+        with self._lock:
+            while self._waiters:
+                w = self._waiters.popleft()
+                if not w.done():
+                    wake = w
+                    break
+            if wake is None:
+                self._credits += 1
+        if wake is not None:
+            def _wake(w=wake):
+                if w.done():
+                    self.release()  # waiter vanished: slot back to pool
+                else:
+                    w.set_result(None)
+
+            self._loop.call_soon_threadsafe(_wake)
+
+
 class _PendingObject:
     """One pending-or-resolved in-process object.
 
@@ -459,8 +521,15 @@ class CoreWorker:
             collections.defaultdict(collections.deque)
         )
         self._actor_pumping: set = set()
-        # per-actor pipelining window: bounds in-flight pushed calls
-        self._actor_windows: Dict[bytes, asyncio.Semaphore] = {}
+        # per-actor pipelining window: bounds in-flight pushed calls.
+        # _ActorWindow (thread-safe credits), NOT asyncio.Semaphore:
+        # the reaper-thread completion path releases a slot without a
+        # loop hop, and the direct-submit path claims one from the
+        # caller thread.
+        self._actor_windows: Dict[bytes, _ActorWindow] = {}
+        # warm streamed conn per ordered actor (direct-submit path —
+        # the caller thread cannot await _conn_to's cache)
+        self._actor_stream_conns: Dict[bytes, Any] = {}
         # streaming push bookkeeping: conn -> {"addr", "specs": {tid: spec}}
         self._inflight_by_conn: Dict[Any, Dict] = {}
         # streamed LEASE pushes: task_id -> completion cb(ok) waking the
@@ -497,6 +566,7 @@ class CoreWorker:
         self._task_events: List[Dict] = []
         self._task_event_lock = threading.Lock()
         self._task_events_flushed = time.monotonic()
+        self._task_events_on = True  # refined after the config handshake
 
         install_ref_hooks(self._on_ref_created, self._on_ref_deleted)
 
@@ -531,7 +601,12 @@ class CoreWorker:
                 self.gcs_subscribe(["logs"])
             except Exception:
                 pass
-        if GLOBAL_CONFIG.task_events_enabled:
+        # cached switch read twice per submission: a plain instance bool
+        # beats the config registry's __getattr__ on the hot path, and
+        # with events off (no GCS task-event consumer) _emit_task_event
+        # is one attribute load + branch — effectively free
+        self._task_events_on = bool(GLOBAL_CONFIG.task_events_enabled)
+        if self._task_events_on:
             async def _event_flusher():
                 while not self._shutdown.is_set():
                     await asyncio.sleep(1.0)
@@ -1354,7 +1429,7 @@ class CoreWorker:
         # Hot path: append a TUPLE; the wire dicts are built at flush
         # (dict construction + f-strings per submission cost real
         # microseconds at 10k tasks/s). Flush every 512 events or 1s.
-        if not GLOBAL_CONFIG.task_events_enabled:
+        if not self._task_events_on:
             return
         with self._task_event_lock:
             self._task_events.append(
@@ -1646,6 +1721,12 @@ class CoreWorker:
                 }
                 conn.sync_notify["task_done"] = self._on_task_done
                 conn.sync_notify["task_done_batch"] = self._on_task_done_batch
+                # the same worker conn may later carry actor pushes:
+                # their singleton completions ride the reaper fast path
+                conn.sync_notify_fast["task_done"] = self._on_task_done_reaper
+                conn.sync_notify_fast["task_done_batch"] = (
+                    self._on_task_done_batch_reaper
+                )
                 conn.add_close_callback(self._on_actor_conn_close)
             while True:
                 pushed = False
@@ -1719,37 +1800,51 @@ class CoreWorker:
             if st.queue:
                 self._maybe_request_lease(key, st)
 
-    def _handle_task_reply(self, spec: TaskSpec, reply: Dict, worker_addr):
-        # Fast path: the overwhelmingly common reply — one return, no
-        # errors, no contained refs — skips the zip/enumerate machinery
-        # below (worth ~10us/call at pipelined actor rates).
-        if (
+    @staticmethod
+    def _reply_is_fast(spec: TaskSpec, reply: Dict) -> bool:
+        """The overwhelmingly common reply shape — one return, no
+        errors, no contained refs — completable without the
+        zip/enumerate machinery (and, for singleton actor completions,
+        directly on the conduit reaper thread)."""
+        return (
             spec.num_returns == 1
             and reply.get("error") is None
             and not reply.get("system_error")
             and not reply.get("contained")
-        ):
-            kind, payload = reply["returns"][0]
-            oid = spec.return_ids()[0]
-            if kind == "v":
-                # materialize the ObjectRef straight from the completion
-                # frame: no store round trip, and no unpack on the IO
-                # loop — consumers decode on their own thread
-                self.task_inline_hits += 1
-                self.task_inline_bytes += len(payload)
-                self.memory_store.put_packed(oid, payload)
-            else:
-                self.memory_store.put_plasma(oid, [worker_addr[2]])
-            self._cancelled.discard(spec.task_id)
-            info = self._pending_tasks.pop(spec.task_id, None)
-            self._recovering.discard(spec.task_id)
+        )
+
+    def _complete_fast_return(self, spec: TaskSpec, reply: Dict,
+                              worker_addr):
+        """Resolve a fast-shape reply (``_reply_is_fast``). Thread-safe:
+        every touched structure is a GIL-atomic dict/set/deque op or the
+        locked memory store, so the reaper-thread singleton fast path
+        and the IO loop can both run it (worth ~10us/call at pipelined
+        actor rates vs the general path)."""
+        kind, payload = reply["returns"][0]
+        oid = spec.return_ids()[0]
+        if kind == "v":
+            # materialize the ObjectRef straight from the completion
+            # frame: no store round trip, and no unpack on the IO
+            # loop — consumers decode on their own thread
+            self.task_inline_hits += 1
+            self.task_inline_bytes += len(payload)
+            self.memory_store.put_packed(oid, payload)
+        else:
+            self.memory_store.put_plasma(oid, [worker_addr[2]])
+        self._cancelled.discard(spec.task_id)
+        info = self._pending_tasks.pop(spec.task_id, None)
+        self._recovering.discard(spec.task_id)
+        if info and info.get("pinned"):
+            self._pin_handoff(info["pinned"])
+        if GLOBAL_CONFIG.lineage_pinning_enabled:
+            self._lineage[oid] = spec
+            self._pull_failures.pop(oid, None)
             if info and info.get("pinned"):
-                self._pin_handoff(info["pinned"])
-            if GLOBAL_CONFIG.lineage_pinning_enabled:
-                self._lineage[oid] = spec
-                self._pull_failures.pop(oid, None)
-                if info and info.get("pinned"):
-                    self._lineage_pinned[spec.task_id] = info["pinned"]
+                self._lineage_pinned[spec.task_id] = info["pinned"]
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: Dict, worker_addr):
+        if self._reply_is_fast(spec, reply):
+            self._complete_fast_return(spec, reply, worker_addr)
             return
         returns = reply.get("returns", [])
         self._cancelled.discard(spec.task_id)  # too late to cancel
@@ -1998,6 +2093,12 @@ class CoreWorker:
             self._gen_streams[spec.task_id] = stream
             refs = [StreamingObjectRefGenerator(stream, refs[0])]
         self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
+        # Latency path (r11): a lone call on a warm ordered stream
+        # pushes its frame straight from THIS thread — no IO-loop
+        # wakeup on the submit leg (the self-pipe write + pump
+        # scheduling cost ~100us+ under cross-thread GIL traffic).
+        if self._direct_actor_submit(spec):
+            return refs
         # EVERY submission appends to the per-actor deque synchronously
         # (GIL-atomic) — the submit thread, not a loop coroutine, fixes
         # the order, so a mixed fast/slow enqueue can never invert two
@@ -2008,6 +2109,64 @@ class CoreWorker:
         if actor_id not in self._actor_pumping:
             self._io_spawn(self._actor_pump(actor_id))
         return refs
+
+    def _direct_actor_submit(self, spec: TaskSpec) -> bool:
+        """Caller-thread direct push (the sync-RTT submit leg).
+
+        Safe only when order cannot be disturbed: the actor is ORDERED
+        (max_concurrency == 1), its queue is empty and no pump is
+        registered (every earlier call is already on the wire — a pump
+        holds its registration from entry, through popleft, until after
+        its sends), the args carry no ObjectRef deps to resolve, the
+        streamed conn is warm+open, and a window credit is free without
+        parking. The executor runs frames in arrival order, so a frame
+        sent here serializes correctly after everything the pump sent.
+        Anything else falls back to the queue+pump path."""
+        if not GLOBAL_CONFIG.actor_direct_submit:
+            return False
+        aid = spec.actor_id
+        if self._actor_conc_cache.get(aid) != 1:
+            return False
+        if self._actor_queues[aid] or aid in self._actor_pumping:
+            return False
+        if spec.task_id in self._cancelled:
+            return False
+        for a in spec.args:
+            if a[0] == "r":
+                return False
+        conn = self._actor_stream_conns.get(aid)
+        if conn is None or conn.closed:
+            return False
+        reg = self._inflight_by_conn.get(conn)
+        if reg is None:
+            return False
+        win = self._actor_windows.get(aid)
+        if win is None or not win.try_acquire():
+            return False
+        info = self._pending_tasks.get(spec.task_id)
+        if info is not None:
+            info["state"] = "running"
+        reg["specs"][spec.task_id] = spec
+        try:
+            # same slim wire as _push_actor_stream, as ONE immediate
+            # frame (no cork: nothing to batch with, and the flush
+            # would cost another call anyway); send_frame is
+            # any-thread-safe and chaos-gated
+            conn.send_frame(rpc._NOTIFY, None, "push_task_c", [
+                spec.task_id, spec.actor_id, spec.method_name, spec.args,
+                spec.num_returns, spec.seq_no, spec.owner,
+                spec.max_retries, spec.trace_ctx,
+            ])
+        except Exception:
+            # dead/failing conn: undo and let the pump's cold path
+            # (address refresh + retries) own this call
+            reg["specs"].pop(spec.task_id, None)
+            if info is not None:
+                info["state"] = "queued"
+            win.release()
+            self._actor_stream_conns.pop(aid, None)
+            return False
+        return True
 
     async def _enqueue_actor_task(self, spec: TaskSpec):
         """Per-actor FIFO with PIPELINED pushes (round 4): the pump still
@@ -2076,8 +2235,9 @@ class CoreWorker:
         try:
             sem = self._actor_windows.get(aid)
             if sem is None:
-                sem = self._actor_windows[aid] = asyncio.Semaphore(
-                    max(1, GLOBAL_CONFIG.actor_pipeline_depth)
+                sem = self._actor_windows[aid] = _ActorWindow(
+                    max(1, GLOBAL_CONFIG.actor_pipeline_depth),
+                    asyncio.get_running_loop(),
                 )
             while q:
                 s = q.popleft()
@@ -2096,7 +2256,7 @@ class CoreWorker:
                 except Exception as e:
                     self._fail_task(s, e)
                     continue
-                if sem.locked():
+                if not sem.available():
                     # about to wait on the peer for a window slot: the
                     # corked pushes must hit the wire first (the replies
                     # that release slots depend on them)
@@ -2301,7 +2461,16 @@ class CoreWorker:
             reg = self._inflight_by_conn[conn] = {"addr": addr, "specs": {}}
             conn.sync_notify["task_done"] = self._on_task_done
             conn.sync_notify["task_done_batch"] = self._on_task_done_batch
+            # singleton completions short-circuit on the reaper thread
+            # (sync-RTT latency path; no-op on asyncio transports)
+            conn.sync_notify_fast["task_done"] = self._on_task_done_reaper
+            conn.sync_notify_fast["task_done_batch"] = (
+                self._on_task_done_batch_reaper
+            )
             conn.add_close_callback(self._on_actor_conn_close)
+        # warm-conn registry for the caller-thread direct-submit path
+        # (only ordered actors ride the streamed pump)
+        self._actor_stream_conns[spec.actor_id] = conn
         info = self._pending_tasks.get(spec.task_id)
         if info is not None:
             info["state"] = "running"
@@ -2332,6 +2501,54 @@ class CoreWorker:
         unpack amortize across the batch)."""
         for entry in batch:
             self._on_task_done(conn, entry)
+
+    # ----- reaper-thread singleton completion (r11 latency path) -----
+    # A sync actor round trip pays engine->reaper->loop->caller on the
+    # return leg: the coalesced reaper->loop wakeup that makes BURSTS
+    # cheap (one self-pipe write per batch) adds a whole loop
+    # scheduling hop to a LONE completion. These handlers consume a
+    # singleton task_done on the reaper thread itself — the memory
+    # store resolves and the blocked get() caller wakes immediately,
+    # and the pipeline-window release (_ActorWindow, thread-safe) frees
+    # the slot without a loop hop too. Batches (>1 completion
+    # per frame) and every retry/error/stream shape return False and
+    # keep the PR-4 coalesced throughput path.
+
+    def _on_task_done_batch_reaper(self, conn, batch) -> bool:
+        if len(batch) != 1:
+            return False  # burst: the coalesced loop path amortizes it
+        return self._on_task_done_reaper(conn, batch[0])
+
+    def _on_task_done_reaper(self, conn, data) -> bool:
+        if not GLOBAL_CONFIG.task_done_reaper_fastpath:
+            return False
+        task_id, reply = data
+        reg = self._inflight_by_conn.get(conn)
+        if reg is None:
+            return False
+        tid = bytes(task_id)
+        spec = reg["specs"].get(tid)
+        if (
+            spec is None
+            or spec.actor_id is None  # lease pushes signal loop state
+            or not self._reply_is_fast(spec, reply)
+        ):
+            return False
+        # committed: pop exactly once (GIL-atomic); the loop-path
+        # handler finding no spec is a no-op, so a racing close/fail
+        # sweep can't double-complete
+        if reg["specs"].pop(tid, None) is None:
+            return False
+        try:
+            self._complete_fast_return(spec, reply, reg["addr"])
+        finally:
+            # the slot MUST free once the pop committed — a raising
+            # completion otherwise leaks a pipeline credit forever
+            # (the loop-path handler no-ops on the popped spec).
+            # _ActorWindow.release is thread-safe: with no parked
+            # acquirer (the sync shape) it frees with zero loop traffic
+            self._release_window(spec.actor_id)
+        return True
 
     def _on_task_done(self, conn, data):
         """Inline (read-loop) completion of a streamed actor or lease
@@ -2382,7 +2599,18 @@ class CoreWorker:
         reg = self._inflight_by_conn.pop(conn, None)
         if reg is None:
             return
-        for spec in reg["specs"].values():
+        for aid, c in list(self._actor_stream_conns.items()):
+            if c is conn:
+                self._actor_stream_conns.pop(aid, None)
+        # pop each spec — the pop is the commit point SHARED with the
+        # reaper-thread fast path (GIL-atomic): whichever side pops the
+        # entry owns its completion, so a task_done mid-dispatch on the
+        # reaper when the conn dies can't ALSO be resubmitted/failed
+        # here (double execution + double window release)
+        for tid in list(reg["specs"].keys()):
+            spec = reg["specs"].pop(tid, None)
+            if spec is None:
+                continue  # reaper fast path completed it concurrently
             if spec.actor_id is None:
                 self._handle_worker_failure(
                     spec, ConnectionError("worker connection closed")

@@ -19,7 +19,7 @@ import asyncio
 import logging
 import os
 import time
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import rpc
@@ -154,30 +154,89 @@ class GcsJournal:
     Redis store client, redis_store_client.h:33 — every mutation is
     durable at ack time, not at the next snapshot tick).
 
-    Every mutating RPC appends one full-value record BEFORE its reply is
-    sent; ``write()+flush()`` lands the bytes in the OS page cache, which
-    survives process death (``gcs_journal_fsync`` additionally buys
-    power-loss durability). Restore = snapshot + ``.old`` journal (if a
-    rotation's snapshot never landed) + current journal, in order —
-    records are absolute values, so replay is idempotent and a torn tail
-    (killed mid-append) is simply ignored.
+    GROUP COMMIT (r11): mutating RPCs ``buffer()`` their records and the
+    server flushes the whole batch with ONE ``write()+flush()`` (and one
+    fsync when ``gcs_journal_fsync`` is set) at the end of the event-loop
+    tick — the RPC replies are deferred until the covering flush lands,
+    so every acked mutation is still durable at ack time.
+    ``write()+flush()`` lands the bytes in the OS page cache, which
+    survives process death (fsync additionally buys power-loss
+    durability). Restore = snapshot + ``.old`` journal (if a rotation's
+    snapshot never landed) + current journal, in order — records are
+    absolute values, so replay is idempotent and a torn tail (killed
+    mid-append) is skipped, not raised.
 
-    Frame format: [u32 len][msgpack record].
+    Frame format (UNCHANGED by batching — a batch is just N consecutive
+    frames, so pre-group-commit journals replay byte-compatibly):
+    [u32 len][msgpack record].
     """
 
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
+        # A SIGKILL mid-append leaves a torn final record; appending
+        # after it would strand every later record behind the tear
+        # (replay stops at the first bad frame). Truncate back to the
+        # last whole-frame boundary before reopening for append.
+        torn = self.scan_valid_prefix(path)
+        if torn is not None:
+            with open(path, "r+b") as f:
+                f.truncate(torn)
         self._f = open(path, "ab")
-        self.appended = 0
+        self.appended = 0  # records flushed (durable)
+        self.flushes = 0   # write+flush batches (group-commit batching)
+        self._buf = bytearray()
+        self._buf_records = 0
 
-    def append(self, rec) -> None:
+    @property
+    def buffered(self) -> int:
+        return self._buf_records
+
+    def buffer(self, rec) -> int:
+        """Frame one record into the in-memory batch; returns the batch
+        depth. Durable only after the next :meth:`flush_buffered`."""
         body = rpc.msgpack.packb(rec, use_bin_type=True)
-        self._f.write(len(body).to_bytes(4, "big") + body)
+        self._buf += len(body).to_bytes(4, "big") + body
+        self._buf_records += 1
+        return self._buf_records
+
+    def take_batch(self) -> Tuple[bytes, int]:
+        """Snapshot-and-clear the buffered batch. Must run on the thread
+        that calls :meth:`buffer` (the event loop): the swap is not
+        atomic, so doing it from an executor could race a concurrent
+        ``buffer()`` and silently drop an acked record."""
+        buf, n = bytes(self._buf), self._buf_records
+        self._buf = bytearray()
+        self._buf_records = 0
+        return buf, n
+
+    def write_batch(self, buf: bytes, n: int) -> int:
+        """Write one already-taken batch with one write+flush (+ one
+        fsync when enabled); returns the record count that became
+        durable. Touches only the file handle and counters, so it is
+        safe on an executor thread while the loop keeps buffering the
+        NEXT batch."""
+        if not n:
+            return 0
+        self._f.write(buf)
         self._f.flush()  # into the page cache: survives SIGKILL
         if self.fsync:
             os.fsync(self._f.fileno())
-        self.appended += 1
+        self.appended += n
+        self.flushes += 1
+        return n
+
+    def flush_buffered(self) -> int:
+        """take_batch + write_batch inline (loop-side or no-loop
+        contexts: append(), rotate(), close(), the fsync-off path)."""
+        return self.write_batch(*self.take_batch())
+
+    def append(self, rec) -> None:
+        """Per-record append (buffer + immediate flush): the
+        pre-group-commit shape, kept for unit tests and as the
+        ``gcs_journal_batch_max=1`` semantics."""
+        self.buffer(rec)
+        self.flush_buffered()
 
     def rotate(self) -> str:
         """Move the current log aside (journal.old) and start fresh; the
@@ -186,6 +245,7 @@ class GcsJournal:
         Must only be called when no ``.old`` exists (i.e. the previous
         snapshot landed) — otherwise un-snapshotted records would be
         overwritten."""
+        self.flush_buffered()  # buffered records belong to this segment
         self._f.close()
         old = self.path + ".old"
         os.replace(self.path, old)
@@ -194,18 +254,50 @@ class GcsJournal:
 
     def reset(self) -> None:
         """Truncate (state fully captured by a just-written snapshot)."""
+        self._buf = bytearray()
+        self._buf_records = 0
         self._f.close()
         self._f = open(self.path, "wb")
 
     def close(self) -> None:
+        try:
+            self.flush_buffered()
+        except Exception:
+            pass
         try:
             self._f.close()
         except Exception:
             pass
 
     @staticmethod
+    def scan_valid_prefix(path: str) -> Optional[int]:
+        """Byte length of the whole-frame prefix of ``path``, or None
+        when the file is absent/fully clean. A torn tail (SIGKILL
+        mid-append) shows up as a trailing partial frame — the returned
+        offset is where an appender must truncate to keep later records
+        reachable by replay."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return None
+        good = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    break
+                n = int.from_bytes(hdr, "big")
+                body = f.read(n)
+                if len(body) < n:
+                    break
+                good += 4 + n
+        return good if good < size else None
+
+    @staticmethod
     def replay(path: str):
-        """Yield records until EOF or the first torn/corrupt frame."""
+        """Yield records until EOF or the first torn/corrupt frame (a
+        SIGKILL mid-append leaves a truncated final record: skip it —
+        only the un-acked tail mutation is lost — never raise)."""
         try:
             f = open(path, "rb")
         except FileNotFoundError:
@@ -214,14 +306,24 @@ class GcsJournal:
             while True:
                 hdr = f.read(4)
                 if len(hdr) < 4:
+                    if hdr:
+                        logger.warning(
+                            "journal %s: torn tail (partial length "
+                            "word) skipped", path)
                     return
                 n = int.from_bytes(hdr, "big")
                 body = f.read(n)
                 if len(body) < n:
+                    logger.warning(
+                        "journal %s: torn tail (%d of %d body bytes) "
+                        "skipped", path, len(body), n)
                     return
                 try:
                     yield rpc.msgpack.unpackb(body, raw=False)
                 except Exception:
+                    logger.warning(
+                        "journal %s: undecodable record skipped "
+                        "(replay stops here)", path)
                     return
 
 
@@ -275,6 +377,14 @@ class GcsServer:
         self._journal_w: Optional[GcsJournal] = None
         self._journal_rotated_old: Optional[str] = None
         self._recovering: Set[bytes] = set()
+        # group-commit state: one pending flush future covers every
+        # record buffered since the previous flush; handlers await it
+        # before replying (durable-at-ack). ``_journal_flushing`` keeps
+        # executor-side fsync flushes single-file so batches land in
+        # buffer order.
+        self._journal_flush_fut: Optional[asyncio.Future] = None
+        self._journal_flush_handle = None
+        self._journal_flushing = False
 
     # ---------------- lifecycle ----------------
     async def start(self):
@@ -427,24 +537,131 @@ class GcsServer:
             self.placement_groups[prec.pg_id] = prec
 
     # -- journal write side (no-ops on the memory backend) --
-    def _journal(self, rec: List):
+    def _journal(self, rec: List) -> Optional[asyncio.Future]:
+        """Group-commit append: frame ``rec`` into the journal's batch
+        buffer and return the future of the COVERING flush (mutations
+        within one event-loop tick share a single write+flush+fsync).
+        Mutating RPC handlers ``await`` the returned future before
+        replying — the durable-at-ack contract of the old per-record
+        ``append()`` at amortized-batch cost. Background mutation paths
+        (placement loops, node-death sweeps) may drop the future: their
+        records ride the same batch and no client is awaiting an ack."""
         j = self._journal_w
         if j is None:
-            return
+            return None
         try:
-            j.append(rec)
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (unit tests / teardown): per-record semantics
+            try:
+                j.append(rec)
+            except Exception:
+                logger.exception(
+                    "GCS journal append failed; journaling disabled")
+                self._journal_w = None
+            self._mark_dirty()
+            return None
+        try:
+            depth = j.buffer(rec)
         except Exception:
             logger.exception("GCS journal append failed; journaling disabled")
             self._journal_w = None
+            self._mark_dirty()
+            return None
         self._mark_dirty()
+        fut = self._journal_flush_fut
+        if fut is None or fut.done():
+            fut = self._journal_flush_fut = loop.create_future()
+        if depth >= max(1, int(GLOBAL_CONFIG.gcs_journal_batch_max)):
+            self._flush_journal_now()
+        elif self._journal_flush_handle is None and not self._journal_flushing:
+            interval = GLOBAL_CONFIG.gcs_journal_flush_interval_s
+            if interval and interval > 0:
+                self._journal_flush_handle = loop.call_later(
+                    interval, self._flush_journal_now)
+            else:
+                # end-of-tick flush: call_soon runs after the currently
+                # ready callbacks, so every handler that buffered in
+                # this tick shares the batch
+                self._journal_flush_handle = loop.call_soon(
+                    self._flush_journal_now)
+        return fut
 
-    def _journal_actor(self, rec: "ActorRecord"):
-        if self._journal_w is not None:
-            self._journal(["actor", rec.to_state()])
+    def _flush_journal_now(self):
+        """Group-commit flush; runs on the event loop. With fsync off
+        the batched write+flush lands inline (page-cache write — the
+        same cost the old per-record path paid per mutation, now per
+        BATCH); with fsync on, the file IO runs in the default executor
+        so the ~ms sync never stalls heartbeats/RPCs on the loop
+        (raylint R1's loop-inline contract)."""
+        h, self._journal_flush_handle = self._journal_flush_handle, None
+        if h is not None:
+            h.cancel()
+        if self._journal_flushing:
+            return  # in-flight executor flush re-runs this on completion
+        j = self._journal_w
+        fut, self._journal_flush_fut = self._journal_flush_fut, None
+        if j is None or not j.buffered:
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            return
+        if not j.fsync:
+            try:
+                j.flush_buffered()
+            except Exception:
+                logger.exception(
+                    "GCS journal flush failed; journaling disabled")
+                self._journal_w = None
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            return
+        loop = asyncio.get_running_loop()
+        self._journal_flushing = True
+        # swap the batch out HERE on the loop — the executor gets an
+        # immutable snapshot, so handlers buffering mid-flush can't
+        # race the swap (their records form the next batch, re-flushed
+        # by _done below)
+        buf, n = j.take_batch()
 
-    def _journal_pg(self, rec: "PgRecord"):
+        def _done(task):
+            self._journal_flushing = False
+            if task.exception() is not None:
+                logger.error("GCS journal flush failed; journaling "
+                             "disabled: %r", task.exception())
+                self._journal_w = None
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            if self._journal_w is not None and self._journal_w.buffered:
+                self._flush_journal_now()  # records buffered mid-flush
+            elif self._journal_flush_fut is not None:
+                # journaling just got disabled (or the mid-flush batch
+                # emptied some other way): handlers that buffered while
+                # this flush was in flight await the SUCCESSOR future —
+                # resolve it or their RPC replies hang forever (matches
+                # the disabled-journal contract: mutations apply
+                # unjournaled, acks still go out)
+                nxt, self._journal_flush_fut = self._journal_flush_fut, None
+                if not nxt.done():
+                    nxt.set_result(True)
+
+        loop.run_in_executor(
+            None, j.write_batch, buf, n).add_done_callback(_done)
+
+    async def _journal_wait(self, fut: Optional[asyncio.Future]):
+        """Durable-at-ack barrier: await the flush covering a just-
+        buffered record (no-op on the memory backend)."""
+        if fut is not None:
+            await fut
+
+    def _journal_actor(self, rec: "ActorRecord") -> Optional[asyncio.Future]:
         if self._journal_w is not None:
-            self._journal(["pg", rec.to_state()])
+            return self._journal(["actor", rec.to_state()])
+        return None
+
+    def _journal_pg(self, rec: "PgRecord") -> Optional[asyncio.Future]:
+        if self._journal_w is not None:
+            return self._journal(["pg", rec.to_state()])
+        return None
 
     async def _recover_after_grace(self):
         """Journal-restored runtime state reconciliation: give raylets one
@@ -479,7 +696,17 @@ class GcsServer:
         is skipped while a previous ``.old`` is still pending (its
         snapshot flush failed), which only means a longer replay."""
         self._dirty = False
-        if self._journal_w is not None and self._journal_rotated_old is None:
+        # never rotate while an executor-side fsync flush is mid-write
+        # (rotate() would swap the file under it) or while records sit
+        # buffered awaiting their group-commit flush (rotate() flushes
+        # them INLINE — with fsync on that's ms of disk wait on the
+        # loop, the exact stall the executor hop exists to avoid).
+        # Skipping just means a longer replay, same as a still-pending
+        # ``.old``
+        if (self._journal_w is not None
+                and self._journal_rotated_old is None
+                and not self._journal_flushing
+                and not self._journal_w.buffered):
             old = self.storage_path + ".journal.old"
             if not os.path.exists(old):
                 try:
@@ -575,6 +802,17 @@ class GcsServer:
                     logger.exception("GCS persistence flush failed")
 
     # ---------------- pubsub ----------------
+    def _publish_locs(self, oid: bytes, locs):
+        """Object-directory invalidation feed ("locs" channel): raylets
+        holding a cached location entry for ``oid`` replace it with
+        ``locs`` (None = object gone everywhere). Published on exactly
+        the mutations that make a cached read STALE — remove-location,
+        free, dead-node purge (additions never stale a cached subset
+        and skip the fan-out) — so the raylet read cache never serves
+        a location the directory has dropped."""
+        if self.subs.get("locs"):
+            self._publish("locs", [[bytes(oid), locs]])
+
     def _publish(self, channel: str, data: Any):
         dead = []
         for conn in self.subs.get(channel, ()):
@@ -609,7 +847,7 @@ class GcsServer:
             return False
         self.kv[key] = value
         self._mark_dirty()
-        self._journal(["kv", key, value])
+        await self._journal_wait(self._journal(["kv", key, value]))
         return True
 
     async def rpc_kv_get(self, conn, key):
@@ -617,8 +855,9 @@ class GcsServer:
 
     async def rpc_kv_del(self, conn, key):
         self._mark_dirty()
-        self._journal(["kv", key, None])
-        return self.kv.pop(key, None) is not None
+        existed = self.kv.pop(key, None) is not None
+        await self._journal_wait(self._journal(["kv", key, None]))
+        return existed
 
     async def rpc_kv_exists(self, conn, key):
         return key in self.kv
@@ -690,13 +929,22 @@ class GcsServer:
             info.labels.get(k) != v for k, v in expect.items()
         ):
             return {"ok": False, "error": "expectation failed"}
+        changed = False
         for key, val in patch.items():
             if val is None:
-                info.labels.pop(key, None)
-            else:
+                if key in info.labels:
+                    info.labels.pop(key, None)
+                    changed = True
+            elif info.labels.get(key) != str(val):
                 info.labels[key] = str(val)
-        self._publish("nodes", [info.to_wire()])
-        return {"ok": True}
+                changed = True
+        # No-op patches (same key -> same value, e.g. a gang re-stamping
+        # its membership every transition) must not republish: every
+        # ``nodes`` subscriber would re-process an unchanged record —
+        # pure fan-out churn on the control plane.
+        if changed:
+            self._publish("nodes", [info.to_wire()])
+        return {"ok": True, "changed": changed}
 
     # -- mesh-group registry (gang observability; transient) --
 
@@ -733,12 +981,15 @@ class GcsServer:
             locs = [bytes(l) for l in rpc.msgpack.unpackb(self.kv[key])]
             if node_id in locs:
                 locs = [l for l in locs if l != node_id]
+                oid = bytes.fromhex(key[4:])
                 if locs:
                     self.kv[key] = rpc.msgpack.packb(locs)
                     self._journal(["kv", key, self.kv[key]])
+                    self._publish_locs(oid, locs)
                 else:
                     self.kv.pop(key, None)
                     self._journal(["kv", key, None])
+                    self._publish_locs(oid, None)
         # Placement groups lose the dead node's bundles -> reschedule them.
         for pg in self.placement_groups.values():
             lost = [i for i, n in enumerate(pg.assignment) if n == node_id]
@@ -773,7 +1024,9 @@ class GcsServer:
         job_id, meta = data
         self.jobs[job_id] = dict(meta, start_time=time.time())
         self._mark_dirty()
-        self._journal(["job", job_id, self.jobs[job_id]])
+        await self._journal_wait(
+            self._journal(["job", job_id, self.jobs[job_id]])
+        )
         return True
 
     async def rpc_get_jobs(self, conn, _):
@@ -799,8 +1052,9 @@ class GcsServer:
             self.named_actors[name] = actor_id
         rec = ActorRecord(actor_id, spec, name=name)
         self.actors[actor_id] = rec
-        self._journal_actor(rec)
+        fut = self._journal_actor(rec)
         asyncio.get_running_loop().create_task(self._place_actor(rec))
+        await self._journal_wait(fut)
         return {"ok": True}
 
     def _pick_node_for(
@@ -1053,8 +1307,10 @@ class GcsServer:
                 pass  # already known (idempotent replay)
             else:
                 stale.append(actor_id)
+        fut = None
         for aid in touched:
-            self._journal_actor(self.actors[aid])
+            fut = self._journal_actor(self.actors[aid])
+        await self._journal_wait(fut)
         if restored:
             logger.info("restored %d live actor(s) from a raylet", restored)
             self._publish(
@@ -1081,7 +1337,7 @@ class GcsServer:
             return False
         if no_restart:
             rec.restarts_left = 0
-            self._journal_actor(rec)
+            await self._journal_wait(self._journal_actor(rec))
         if rec.address is None:
             # Still placing (PENDING/RESTARTING): mark dead now; _place_actor
             # checks state and kills a worker that wins the race.
@@ -1137,8 +1393,9 @@ class GcsServer:
                                 "STRICT_SPREAD"):
             return {"ok": False, "error": f"bad strategy {rec.strategy!r}"}
         self.placement_groups[pg_id] = rec
-        self._journal_pg(rec)
+        fut = self._journal_pg(rec)
         asyncio.get_running_loop().create_task(self._place_pg(rec))
+        await self._journal_wait(fut)
         return {"ok": True}
 
     async def rpc_get_placement_group(self, conn, pg_id: bytes):
@@ -1328,8 +1585,12 @@ class GcsServer:
         locs.add(node_id)
         self.kv[key] = rpc.msgpack.packb([bytes(l) for l in locs])
         # journaled so a live GCS restart loses no object directory entries
-        # (a lost loc: entry surfaces as ObjectLost to the owner)
-        self._journal(["kv", key, self.kv[key]])
+        # (a lost loc: entry surfaces as ObjectLost to the owner).
+        # NOT published to the locs channel: an ADDED copy never stales
+        # a cached entry (a subset of live locations still serves a
+        # pull), so adds don't pay the fan-out
+        fut = self._journal(["kv", key, self.kv[key]])
+        await self._journal_wait(fut)
         return True
 
     async def rpc_remove_object_location(self, conn, data):
@@ -1342,10 +1603,13 @@ class GcsServer:
         s.discard(node_id)
         if s:
             self.kv[key] = rpc.msgpack.packb(sorted(s))
-            self._journal(["kv", key, self.kv[key]])
+            fut = self._journal(["kv", key, self.kv[key]])
+            self._publish_locs(oid, sorted(s))
         else:
             self.kv.pop(key, None)
-            self._journal(["kv", key, None])
+            fut = self._journal(["kv", key, None])
+            self._publish_locs(oid, None)
+        await self._journal_wait(fut)
         return True
 
     async def rpc_get_object_locations(self, conn, oid):
@@ -1423,8 +1687,10 @@ class GcsServer:
         key = "loc:" + oid_bytes.hex()
         locs = self.kv.pop(key, None)
         self._pulls.pop(bytes(oid_bytes), None)  # freed: entry is moot
+        fut = None
         if locs is not None:
-            self._journal(["kv", key, None])
+            fut = self._journal(["kv", key, None])
+            self._publish_locs(bytes(oid_bytes), None)
         nodes = (
             [bytes(n) for n in rpc.msgpack.unpackb(locs)] if locs else []
         )
@@ -1435,6 +1701,7 @@ class GcsServer:
                     raylet.call_async("free_local_object", oid_bytes,
                                       timeout=10)
                 )
+        await self._journal_wait(fut)
         return True
 
     # ---------------- task events (observability) ----------------
@@ -1516,6 +1783,14 @@ class GcsServer:
             },
             "journal_appended": (
                 self._journal_w.appended if self._journal_w else None
+            ),
+            # group-commit effectiveness: flushes << appended means the
+            # batcher is actually amortizing write+flush(+fsync) calls
+            "journal_flushes": (
+                self._journal_w.flushes if self._journal_w else None
+            ),
+            "journal_buffered": (
+                self._journal_w.buffered if self._journal_w else None
             ),
             "recovering_actors": len(self._recovering),
             "method_stats": rpc.method_stats().snapshot(),

@@ -34,7 +34,7 @@ class Address:
         return cls(w[0], w[1], w[2])
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class TaskSpec:
     task_id: bytes
     function_id: bytes  # GCS KV key of the pickled function / actor class
@@ -61,6 +61,12 @@ class TaskSpec:
     # [trace_id, parent_span_id, span_id] when tracing is enabled
     # (parity: reference tracing_helper.py:322 span context in metadata)
     trace_ctx: Optional[List[str]] = None
+    # return_ids() memo — a field so the slots=True class keeps the
+    # cache slot (never serialized: to_wire is hand-rolled and wire
+    # dicts can't carry it into from_wire's field filter)
+    _return_ids: Optional[List["ObjectID"]] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def to_wire(self) -> Dict:
         # hand-rolled shallow dict: dataclasses.asdict deep-copies every
@@ -108,7 +114,7 @@ class TaskSpec:
         # get deterministic ids via yield_object_id().
         # Cached: called 3+ times per task on the submit/reply hot path,
         # and task_id/num_returns never change after construction.
-        cached = getattr(self, "_return_ids", None)
+        cached = self._return_ids
         if cached is not None:
             return cached
         n = 1 if self.num_returns in (-1, -2) else self.num_returns

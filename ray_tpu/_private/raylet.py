@@ -16,6 +16,7 @@ the GCS-gossiped resource view.
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import subprocess
@@ -356,6 +357,23 @@ class Raylet:
         # locality-aware stripe-peer picks: pulls whose first-choice
         # source shared this node's host (or gang) label
         self._locality_pref_hits = 0
+        # GCS read cache (r11): object-location entries enter on a
+        # directory read (populate-on-miss — a first-time puller still
+        # registers with the broadcast-tree registry) and are
+        # updated/invalidated by the "locs" pubsub channel; cleared
+        # whole on GCS reconnect (a subscription gap means missed
+        # invalidations). Entry: oid -> {"locs": [node_id], "size":
+        # Optional[int]} — a known-small object (< broadcast threshold)
+        # can skip the pull_begin round trip entirely. The node
+        # labels/table cache is ``cluster_nodes`` (pubsub-fed since r1,
+        # label patches adopted since r10); its churn counts below.
+        self._loc_cache: "collections.OrderedDict[bytes, Dict]" = (
+            collections.OrderedDict()
+        )
+        self._gcs_cache_stats = {
+            "loc_hits": 0, "loc_misses": 0, "loc_invalidations": 0,
+            "loc_updates": 0, "node_updates": 0, "cache_resets": 0,
+        }
         # node_stats mesh-group cache (monotonic ts, dict): one GCS
         # registry read per ~2s, however often stats are polled
         self._mesh_group_cache: Tuple[float, Dict] = (0.0, {})
@@ -449,8 +467,15 @@ class Raylet:
             ).to_wire(),
         )
         GLOBAL_CONFIG.load(reply["config"])
+        # the read caches are only coherent while subscribed: a
+        # (re-)registration starts a fresh subscription epoch, so drop
+        # every location entry cached under the previous one (missed
+        # invalidations during the gap)
+        if self._loc_cache:
+            self._loc_cache.clear()
+            self._gcs_cache_stats["cache_resets"] += 1
         snap = await self._gcs_call_replayed(
-            "subscribe", ["nodes", "resources"]
+            "subscribe", ["nodes", "resources", "locs"]
         )
         for n in snap.get("nodes", []):
             self._on_nodes_update([n])
@@ -518,9 +543,49 @@ class Raylet:
             self.cluster_resources = payload
         elif channel == "nodes":
             self._on_nodes_update(payload)
+        elif channel == "locs":
+            self._on_locs_update(payload)
         return True
 
+    def _on_locs_update(self, updates: List):
+        """Explicit invalidation feed for the object-location cache: the
+        GCS publishes [oid, locations|None] on exactly the directory
+        mutations that stale a cached entry. Entries NOT in the cache
+        are ignored (the cache populates on read, never on pubsub — a
+        first-time puller must still register with the broadcast-tree
+        registry instead of short-circuiting to a direct fetch)."""
+        for oid, locs in updates:
+            oid = bytes(oid)
+            ent = self._loc_cache.get(oid)
+            if ent is None:
+                continue
+            if locs is None:
+                self._loc_cache.pop(oid, None)
+                self._gcs_cache_stats["loc_invalidations"] += 1
+            else:
+                ent["locs"] = [bytes(l) for l in locs]
+                self._gcs_cache_stats["loc_updates"] += 1
+
+    def _loc_cache_put(self, oid: bytes, locs, size=None):
+        cap = int(GLOBAL_CONFIG.raylet_loc_cache_entries)
+        if cap <= 0:
+            return
+        ent = self._loc_cache.get(oid)
+        if ent is not None:
+            ent["locs"] = [bytes(l) for l in locs]
+            if size is not None:
+                ent["size"] = int(size)
+            self._loc_cache.move_to_end(oid)
+            return
+        while len(self._loc_cache) >= cap:
+            self._loc_cache.popitem(last=False)
+        self._loc_cache[oid] = {
+            "locs": [bytes(l) for l in locs],
+            "size": int(size) if size is not None else None,
+        }
+
     def _on_nodes_update(self, nodes: List[Dict]):
+        self._gcs_cache_stats["node_updates"] += len(nodes)
         for n in nodes:
             nhex = bytes(n["node_id"]).hex()
             self.cluster_nodes[nhex] = n
@@ -1756,7 +1821,28 @@ class Raylet:
                 if self.store.contains(oid):
                     return True
                 parents: List[bytes] = []
-                if fanout > 0:
+                # GCS read cache: a cached directory entry serves the
+                # steady-state pull without round-tripping the GCS.
+                # Tree-eligible objects (unknown size, or >= the
+                # broadcast threshold) still call pull_begin — the
+                # registry read doubles as the puller registration the
+                # fan-out tree is built from. Retry attempts bypass and
+                # drop the entry (stale locations are the usual reason
+                # the previous attempt failed).
+                if attempt == 0:
+                    cached = self._loc_cache.get(oid_bytes)
+                else:
+                    self._loc_cache.pop(oid_bytes, None)
+                    cached = None
+                if cached is not None and cached["locs"] and (
+                    fanout <= 0
+                    or (cached["size"] is not None
+                        and cached["size"] < min_tree)
+                ):
+                    self._gcs_cache_stats["loc_hits"] += 1
+                    locs = list(cached["locs"])
+                elif fanout > 0:
+                    self._gcs_cache_stats["loc_misses"] += 1
                     try:
                         info = await self.gcs.call_async(
                             "pull_begin",
@@ -1773,10 +1859,15 @@ class Raylet:
                         locs = await self.gcs.call_async(
                             "get_object_locations", oid_bytes
                         )
+                    if locs:
+                        self._loc_cache_put(oid_bytes, locs)
                 else:
+                    self._gcs_cache_stats["loc_misses"] += 1
                     locs = await self.gcs.call_async(
                         "get_object_locations", oid_bytes
                     )
+                    if locs:
+                        self._loc_cache_put(oid_bytes, locs)
                 cands = []
                 for node_id in locs:
                     nid_hex = bytes(node_id).hex()
@@ -1809,6 +1900,18 @@ class Raylet:
                 if GLOBAL_CONFIG.object_transfer_same_host_shm:
                     for node in cands:
                         if await self._pull_same_host_shm(oid, node):
+                            # size-stamp the cache off the just-landed
+                            # local copy (the socket path stamps from
+                            # its meta probe below)
+                            if locs:
+                                view = self.store.get(oid, timeout=0)
+                                if view is not None:
+                                    nbytes = view.nbytes
+                                    view.release()
+                                    self.store.release(oid)
+                                    self._loc_cache_put(
+                                        oid_bytes, locs, nbytes
+                                    )
                             return True
                 addrs = [n["raylet_addr"] for n in cands]
                 loc_by_addr = {
@@ -1849,6 +1952,10 @@ class Raylet:
                 sealed_size = (
                     int(sources[0][1]["size"]) if sources else None
                 )
+                if sealed_size is not None and locs:
+                    # size-stamp the cache entry: a repeat pull of a
+                    # known-small object can then skip the GCS entirely
+                    self._loc_cache_put(oid_bytes, locs, sealed_size)
                 if parent_nodes and not psources and (
                     sealed_size is None or sealed_size >= min_tree
                 ):
@@ -2715,6 +2822,12 @@ class Raylet:
             "objects_served": self._objects_served,
             "outbound_chunks": self._outbound_chunks,
             "store": self.store.stats() if self.store else {},
+            # GCS read caches (r11): object-location cache hit/miss/
+            # invalidation counters + the pubsub-fed node-table churn —
+            # how often steady-state pulls avoid a GCS round trip
+            "gcs_cache": dict(self._gcs_cache_stats,
+                              loc_entries=len(self._loc_cache),
+                              node_entries=len(self.cluster_nodes)),
             "task_plane": await self._task_plane_stats(),
             # gang membership of this node (mesh-group compute plane):
             # rendezvous epoch, lifecycle state, steps, last failure

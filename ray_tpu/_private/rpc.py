@@ -182,6 +182,12 @@ class Connection:
         # method -> fn(conn, data): notifies dispatched INLINE in the read
         # loop (no handler task) — the data-plane reply hot path
         self.sync_notify: Dict[str, Callable] = {}
+        # reaper-thread fast-path registry (ConduitConnection parity).
+        # Unused here: the asyncio read loop IS the event loop, so
+        # sync_notify already dispatches with zero thread hops —
+        # registrants set both tables without caring which transport
+        # the connection rides.
+        self.sync_notify_fast: Dict[str, Callable] = {}
         # raw-frame plumbing: seqno -> sink for in-flight call_raw_async
         # (sink(meta, payload_view) runs inline in the read loop, copying
         # the payload into its destination before the buffer is dropped);

@@ -1,0 +1,319 @@
+"""Control-plane scale-out tests (r11): GCS journal group commit +
+raylet-side GCS read caches.
+
+Covers the r11 contracts:
+- group-commit batching actually amortizes journal flushes while
+  keeping durable-at-ack (a SIGKILL landing between an ack and the
+  next tick loses nothing that was acked);
+- ``GcsJournal.replay`` tolerates a torn tail at EVERY byte offset of
+  the final record, and a writer reopening a torn journal truncates
+  the tear so later appends stay reachable;
+- the raylet object-location cache serves repeat pulls without a GCS
+  round trip and invalidates on the exact mutation that staled it;
+- ``update_node_labels`` suppresses no-op republishes;
+- a >=100k-record journal replays inside a restore-time bound.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs import GcsJournal
+from ray_tpu.cluster_utils import Cluster
+
+
+# ---------------------------------------------------------------- journal
+
+
+def _journal_bytes(records):
+    j_path_records = []
+    import msgpack
+
+    out = bytearray()
+    for rec in records:
+        body = msgpack.packb(rec, use_bin_type=True)
+        out += len(body).to_bytes(4, "big") + body
+        j_path_records.append(len(body))
+    return bytes(out)
+
+
+def test_torn_tail_skipped_at_every_byte_offset(tmp_path):
+    """SIGKILL mid-append truncates the file at an arbitrary byte: for
+    EVERY truncation point inside the final record, replay must yield
+    all complete records and never raise."""
+    records = [["kv", f"k{i}", b"v" * (i + 1)] for i in range(4)]
+    blob = _journal_bytes(records)
+    last_len = len(blob) - len(_journal_bytes(records[:-1]))
+    base = len(blob) - last_len
+    for cut in range(base, len(blob)):
+        p = str(tmp_path / f"j{cut}")
+        with open(p, "wb") as f:
+            f.write(blob[:cut])
+        got = list(GcsJournal.replay(p))
+        assert got == records[:-1], (cut, got)
+    # untruncated: all four come back
+    p = str(tmp_path / "full")
+    with open(p, "wb") as f:
+        f.write(blob)
+    assert list(GcsJournal.replay(p)) == records
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    """The append-after-tear hole: records appended BEHIND a torn tail
+    would be unreachable (replay stops at the tear). A writer opening a
+    torn journal truncates back to the last whole frame first."""
+    p = str(tmp_path / "j")
+    j = GcsJournal(p)
+    j.append(["kv", "a", b"1"])
+    j.append(["kv", "b", b"2"])
+    j.close()
+    full = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(full - 3)  # tear the final record
+    j2 = GcsJournal(p)  # reopen truncates the tear...
+    j2.append(["kv", "c", b"3"])  # ...so this append is reachable
+    j2.close()
+    assert list(GcsJournal.replay(p)) == [
+        ["kv", "a", b"1"], ["kv", "c", b"3"],
+    ]
+
+
+def test_group_commit_framing_is_replay_compatible(tmp_path):
+    """A batch is byte-identical to the same records appended one at a
+    time — old journals replay through the same loop unchanged."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    recs = [["kv", f"k{i}", b"x" * 32] for i in range(10)]
+    ja = GcsJournal(a)
+    for r in recs:
+        ja.append(r)  # per-record flush (legacy shape)
+    ja.close()
+    jb = GcsJournal(b)
+    for r in recs:
+        jb.buffer(r)
+    assert jb.flush_buffered() == 10  # ONE write+flush
+    jb.close()
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert fa.read() == fb.read()
+    assert list(GcsJournal.replay(b)) == recs
+    assert jb.flushes == 1 and jb.appended == 10
+
+
+def test_journal_replay_100k_within_bound(tmp_path):
+    """Restore time is a liveness property: a >=100k-entry journal (a
+    busy cluster's un-snapshotted delta) must replay well inside the
+    health-check envelope."""
+    p = str(tmp_path / "big")
+    j = GcsJournal(p)
+    for i in range(100_000):
+        j.buffer(["kv", f"k{i % 2048}", b"v" * 48])
+        if j.buffered >= 1024:
+            j.flush_buffered()
+    j.close()
+    t0 = time.perf_counter()
+    n = sum(1 for _ in GcsJournal.replay(p))
+    dt = time.perf_counter() - t0
+    assert n == 100_000
+    assert dt < 10.0, f"100k-record replay took {dt:.1f}s"
+
+
+# ---------------------------------------------------------- group commit
+
+
+def test_group_commit_batches_and_survives_sigkill():
+    """THE r11 durability contract: concurrent mutations share journal
+    flushes (flushes < appended), and a GCS SIGKILL with NO snapshot
+    window — immediately after the last ack — loses nothing acked."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2}},
+        system_config={
+            "gcs_storage_backend": "file",
+            "gcs_snapshot_interval_s": 3600.0,  # snapshots never fire
+        },
+        use_tcp=True,
+    )
+    c.connect()
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        gcs = global_worker.core_worker.gcs
+        n_threads, per = 8, 25
+        clis = [rpc.Client.connect(c._impl.gcs_addr, name=f"t{i}")
+                for i in range(n_threads)]
+
+        def put(i):
+            for k in range(per):
+                assert clis[i].call(
+                    "kv_put", [f"gc:{i}:{k}", b"d", True], timeout=30
+                )
+
+        ts = [threading.Thread(target=put, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        state = gcs.call("internal_state", None, timeout=10)
+        assert state["journal_appended"] >= n_threads * per
+        # group commit: concurrent handlers shared write+flush batches
+        assert state["journal_flushes"] < state["journal_appended"], state
+        # nothing buffered past the acks (durable-at-ack means the
+        # covering flush landed before each reply)
+        assert state["journal_buffered"] == 0, state
+
+        # SIGKILL + restart with no flush window: every acked put is in
+        # the journal already
+        c._impl.restart_gcs()
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                v = gcs.call("kv_get", f"gc:{n_threads - 1}:{per - 1}",
+                             timeout=5)
+                if v is not None:
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "acked key lost"
+            time.sleep(0.2)
+        for i in range(n_threads):
+            for k in range(per):
+                assert gcs.call("kv_get", f"gc:{i}:{k}", timeout=10) == b"d", (
+                    f"acked mutation gc:{i}:{k} lost across SIGKILL"
+                )
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------ read caches
+
+
+def _other_raylet_client(c):
+    head_hex = c.head_node.node_id.hex()
+    other = [n for n in ray_tpu.nodes()
+             if n["node_id"].hex() != head_hex][0]
+    return rpc.Client.connect(other["raylet_addr"], name="cache-test")
+
+
+def test_raylet_loc_cache_hit_and_invalidation():
+    """Steady-state pulls stop round-tripping the GCS: the second pull
+    of a (small) object is served from the raylet's location cache, and
+    the free that deletes the object invalidates the entry via the
+    ``locs`` pubsub channel."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+        system_config={
+            "object_store_memory_bytes": 128 * 1024 * 1024,
+            "prestart_workers": False,
+            "log_to_driver": False,
+        },
+    )
+    c.add_node(num_cpus=1, resources={"other": 1})
+    c.connect()
+    try:
+        import numpy as np
+
+        arr = np.random.randint(0, 255, 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        cli = _other_raylet_client(c)
+        cli.call("node_stats", None, timeout=30)
+
+        assert cli.call("pull_object", ref.binary(), timeout=120,
+                        retry=False) is True
+        s1 = cli.call("node_stats", None, timeout=30)["gcs_cache"]
+        assert s1["loc_misses"] >= 1
+        assert s1["loc_entries"] >= 1
+
+        # drop the local copy; the repeat pull must hit the cache (the
+        # first pull size-stamped the entry and 1 MiB is far below the
+        # broadcast-tree threshold). Note location ADDS are not
+        # published (they never stale a cached subset), so the cached
+        # entry still reads [head] — exactly what the pull needs.
+        cli.call("free_local_object", ref.binary(), timeout=30)
+        assert cli.call("pull_object", ref.binary(), timeout=120,
+                        retry=False) is True
+        s2 = cli.call("node_stats", None, timeout=30)["gcs_cache"]
+        assert s2["loc_hits"] >= s1["loc_hits"] + 1, (s1, s2)
+
+        # owner frees the object -> GCS publishes the invalidation ->
+        # the cached entry dies (no stale location survives)
+        cli.call("free_local_object", ref.binary(), timeout=30)
+        del ref
+        deadline = time.monotonic() + 15
+        while True:
+            s3 = cli.call("node_stats", None, timeout=30)["gcs_cache"]
+            if s3["loc_invalidations"] >= 1 and s3["loc_entries"] == 0:
+                break
+            assert time.monotonic() < deadline, s3
+            time.sleep(0.2)
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+def test_label_patch_updates_view_and_noop_suppressed():
+    """A label patch republishes the node record (the raylet's cached
+    node-table/labels view updates); re-applying the SAME patch is a
+    no-op and must NOT republish (gang re-stamps would churn every
+    ``nodes`` subscriber)."""
+    c = Cluster(initialize_head=True,
+                head_node_args={"resources": {"CPU": 2}})
+    c.connect()
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        gcs = global_worker.core_worker.gcs
+        node_id = c.head_node.node_id
+        cli = rpc.Client.connect(
+            ray_tpu.nodes()[0]["raylet_addr"], name="label-test")
+
+        r = gcs.call("update_node_labels",
+                     [node_id, {"bench/zone": "z1"}], timeout=10)
+        assert r["ok"] and r["changed"] is True
+        deadline = time.monotonic() + 10
+        while True:
+            ns = cli.call("node_stats", None, timeout=10)
+            if ns["labels"].get("bench/zone") == "z1":
+                break
+            assert time.monotonic() < deadline, ns["labels"]
+            time.sleep(0.1)
+        base_updates = ns["gcs_cache"]["node_updates"]
+
+        # identical patch: applied as a no-op, no republish
+        r = gcs.call("update_node_labels",
+                     [node_id, {"bench/zone": "z1"}], timeout=10)
+        assert r["ok"] and r["changed"] is False
+        time.sleep(0.5)  # a republish would land well inside this
+        ns = cli.call("node_stats", None, timeout=10)
+        assert ns["gcs_cache"]["node_updates"] == base_updates, (
+            "no-op label patch republished the node record"
+        )
+
+        # a REAL change still republishes
+        r = gcs.call("update_node_labels",
+                     [node_id, {"bench/zone": "z2"}], timeout=10)
+        assert r["ok"] and r["changed"] is True
+        deadline = time.monotonic() + 10
+        while True:
+            ns = cli.call("node_stats", None, timeout=10)
+            if ns["labels"].get("bench/zone") == "z2":
+                break
+            assert time.monotonic() < deadline, ns["labels"]
+            time.sleep(0.1)
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass

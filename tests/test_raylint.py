@@ -157,6 +157,56 @@ def test_suppression_silences(rule):
     assert suppressed >= 1
 
 
+def test_r1_covers_loop_inline_sync_defs():
+    """r11 prong: a SYNC def whose docstring declares it runs on the
+    event loop (a call_soon/call_later callback — the GCS journal
+    group-commit flush shape) gets R1's blocking checks; os.fsync
+    inline is the exemplar finding, run_in_executor the fix."""
+    from tools.raylint import lint_source
+
+    bad = textwrap.dedent("""
+        import os
+        def _flush_journal_now(self):
+            '''Group-commit flush; runs on the event loop.'''
+            self._f.write(bytes(self._buf))
+            self._f.flush()
+            os.fsync(self._f.fileno())
+    """)
+    findings, _ = lint_source(bad, "_private/gcs.py")
+    assert any(
+        f.rule == "R1" and "os.fsync" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+    good = textwrap.dedent("""
+        import asyncio
+        def _flush_journal_now(self):
+            '''Group-commit flush; runs on the event loop.'''
+            loop = asyncio.get_running_loop()
+            loop.run_in_executor(None, self._journal.flush_buffered)
+    """)
+    findings, _ = lint_source(good, "_private/gcs.py")
+    assert findings == [], [f.as_dict() for f in findings]
+
+    # an UNMARKED sync def keeps its old freedom (plain file IO off
+    # the loop is not raylint's business)
+    unmarked = textwrap.dedent("""
+        import os
+        def flush(self):
+            os.fsync(self._f.fileno())
+    """)
+    findings, _ = lint_source(unmarked, "_private/gcs.py")
+    assert findings == []
+
+    # fsync inline in an ASYNC def fires via the extended blocking set
+    async_bad = textwrap.dedent("""
+        import os
+        async def persist(self):
+            os.fsync(self._fd)
+    """)
+    findings, _ = lint_source(async_bad, "_private/gcs.py")
+    assert any(f.rule == "R1" for f in findings)
+
+
 def test_r3_covers_conduit_batch_send():
     """R3 extends to the r8 conduit-batch send path: a cork flush that
     hands pre-framed bytes to ``engine.send_batch`` (or raw

@@ -286,3 +286,69 @@ def test_envelope_smoke_50k_queued():
         assert rss_end - rss0 < 600 * 1024 * 1024
     finally:
         ray_tpu.shutdown()
+
+
+def test_sync_direct_submit_order_and_fastpath(rt):
+    """r11 latency paths: lone ordered-actor calls ride the caller-
+    thread direct-submit leg and the reaper-thread completion leg, and
+    arbitrary interleavings of sync calls (direct-eligible) with
+    pipelined bursts (pump path) must still execute in submission
+    order on an ordered actor."""
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, x):
+            self.seen.append(x)
+            return x
+
+        def dump(self):
+            return list(self.seen)
+
+    a = Log.remote()
+    expect = []
+    n = 0
+    for round_i in range(6):
+        # sync singles (direct-submit shape: empty queue, warm conn)
+        for _ in range(3):
+            assert ray_tpu.get(a.add.remote(n), timeout=60) == n
+            expect.append(n)
+            n += 1
+        # a burst (pump path, corked) immediately behind them
+        refs = []
+        for _ in range(40):
+            refs.append(a.add.remote(n))
+            expect.append(n)
+            n += 1
+        assert ray_tpu.get(refs, timeout=60) == expect[-40:]
+    assert ray_tpu.get(a.dump.remote(), timeout=60) == expect
+
+
+def test_direct_submit_disabled_parity(rt):
+    """The direct-submit and reaper fast paths are pure latency
+    optimizations: with both knobs off, results are identical."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    old_direct = GLOBAL_CONFIG.actor_direct_submit
+    old_reaper = GLOBAL_CONFIG.task_done_reaper_fastpath
+    try:
+        GLOBAL_CONFIG.load({"actor_direct_submit": False,
+                            "task_done_reaper_fastpath": False})
+
+        @ray_tpu.remote
+        class C:
+            def __init__(self):
+                self.x = 0
+
+            def inc(self):
+                self.x += 1
+                return self.x
+
+        a = C.remote()
+        assert [ray_tpu.get(a.inc.remote(), timeout=60)
+                for _ in range(10)] == list(range(1, 11))
+    finally:
+        GLOBAL_CONFIG.load({"actor_direct_submit": old_direct,
+                            "task_done_reaper_fastpath": old_reaper})

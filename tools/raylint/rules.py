@@ -63,9 +63,19 @@ _R1_BLOCKING = {
     "socket.create_connection",
     "socket.getaddrinfo",
     "socket.gethostbyname",
+    # r11 (GCS journal group commit): a per-batch fsync is ~ms of
+    # synchronous disk wait — run it in an executor, never inline on
+    # the loop (the batched page-cache write+flush is fine inline)
+    "os.fsync",
+    "os.fdatasync",
 }
 #: R1: blocking file ops (use asyncio.to_thread / run_in_executor).
 _R1_FILE = {"open", "os.listdir", "os.stat", "os.path.getsize"}
+#: R1 sync-def prong (r11): SYNC functions that by contract execute on
+#: the event loop (call_soon/call_later callbacks — the GCS journal
+#: group-commit flush is the exemplar) declare it in their docstring
+#: and get the same blocking/file checks as async defs.
+_R1_LOOP_MARKERS = ("runs on the event loop", "loop-inline")
 
 #: R3 scope + R4 module-prong scope (wire/control modules by basename).
 #: raylet.py joined R3 in r9: the broadcast-tree fan-out serves chunk
@@ -153,8 +163,10 @@ def _subtree_calls(node: ast.AST) -> Set[int]:
 # ---------------------------------------------------------------- rules
 
 
-def _check_r1(fn: ast.AsyncFunctionDef, path: str, aliases,
+def _check_r1(fn, path: str, aliases,
               findings: List[Finding]):
+    is_async = isinstance(fn, ast.AsyncFunctionDef)
+    what = "async def" if is_async else "loop-inline def"
     awaited: Set[int] = set()
     for node in _walk_skip_nested(fn):
         if isinstance(node, ast.Await):
@@ -165,27 +177,27 @@ def _check_r1(fn: ast.AsyncFunctionDef, path: str, aliases,
             if name in _R1_BLOCKING:
                 findings.append(Finding(
                     path, node.lineno, node.col_offset, "R1",
-                    f"blocking call {name}() inside async def "
+                    f"blocking call {name}() inside {what} "
                     f"{fn.name} (stalls the event loop)",
                     func_line=fn.lineno))
             elif name in _R1_FILE:
                 findings.append(Finding(
                     path, node.lineno, node.col_offset, "R1",
-                    f"blocking file op {name}() inside async def "
+                    f"blocking file op {name}() inside {what} "
                     f"{fn.name} (use asyncio.to_thread / "
                     f"run_in_executor)", func_line=fn.lineno))
             elif (name.endswith(".result") and "?" not in name
                   and id(node) not in awaited):
                 findings.append(Finding(
                     path, node.lineno, node.col_offset, "R1",
-                    f"{name}() inside async def {fn.name}: blocks the "
+                    f"{name}() inside {what} {fn.name}: blocks the "
                     f"loop if the future is not done (await it, or "
                     f"guard with .done())", func_line=fn.lineno))
             elif (name.endswith((".acquire", ".wait"))
                   and "?" not in name and id(node) not in awaited):
                 findings.append(Finding(
                     path, node.lineno, node.col_offset, "R1",
-                    f"un-awaited {name}() inside async def {fn.name}: "
+                    f"un-awaited {name}() inside {what} {fn.name}: "
                     f"a threading primitive here blocks the loop "
                     f"(asyncio primitives must be awaited)",
                     func_line=fn.lineno))
@@ -414,5 +426,14 @@ def check_tree(tree: ast.AST, path: str,
                 _check_r1(node, path, aliases, findings)
             if "R6" in enabled:
                 _check_r6(node, path, findings)
+        elif isinstance(node, ast.FunctionDef):
+            # r11: SYNC defs that contractually run ON the loop
+            # (call_soon / call_later callbacks) opt into R1 via a
+            # docstring marker — the GCS group-commit flush path's
+            # "no inline fsync on the loop" invariant
+            if "R1" in enabled and in_private:
+                doc = (ast.get_docstring(node) or "").lower()
+                if any(m in doc for m in _R1_LOOP_MARKERS):
+                    _check_r1(node, path, aliases, findings)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
