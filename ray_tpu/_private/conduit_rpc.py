@@ -163,6 +163,7 @@ class ConduitConnection:
         # batched task_done completions (see task_done_fn)
         self._done_lock = threading.Lock()
         self._done_buf: List = []
+        self._done_flush_armed = False  # deferred starvation-bound flush
         # chaos-plane link identity (see rpc.Connection.chaos_peer)
         self.chaos_peer = ""
         self._chaos_seq = itertools.count()  # thread-safe enough (GIL)
@@ -340,13 +341,29 @@ class ConduitConnection:
 
         def fn(reply):
             try:
+                batch = None
                 with self._done_lock:
                     self._done_buf.append([task_id, reply])
-                    if len(self._done_buf) < 16 and not (
+                    if len(self._done_buf) >= 16 or (
                         flush_hint is None or flush_hint()
                     ):
-                        return
-                    batch, self._done_buf = self._done_buf, []
+                        batch, self._done_buf = self._done_buf, []
+                    elif not self._done_flush_armed:
+                        # Starvation bound (r12): when ANOTHER caller's
+                        # steady churn keeps the executor's queue
+                        # permanently non-empty, neither the size
+                        # trigger nor the idle-tick backstop ever fires
+                        # and a lone buffered completion stalls its
+                        # caller FOREVER (the data plane's split
+                        # coordinator hit exactly this: one consumer's
+                        # polls starved the other consumer's reply).
+                        # A deferred flush on the loop caps the wait at
+                        # ~2 ms while bursts still batch.
+                        self._done_flush_armed = True
+                        self.loop.call_soon_threadsafe(
+                            self.loop.call_later, 0.002,
+                            self.flush_task_done,
+                        )
                 if batch:
                     self.send_frame(
                         rpc._NOTIFY, None, "task_done_batch", batch
@@ -357,10 +374,12 @@ class ConduitConnection:
         return fn
 
     def flush_task_done(self):
-        """Backstop flush (exec-loop idle tick): completions buffered
-        behind another caller's queued work must not stall."""
+        """Backstop flush (exec-loop idle tick + the deferred
+        starvation-bound timer): completions buffered behind another
+        caller's queued work must not stall."""
         try:
             with self._done_lock:
+                self._done_flush_armed = False
                 batch, self._done_buf = self._done_buf, []
             if batch:
                 self.send_frame(rpc._NOTIFY, None, "task_done_batch", batch)

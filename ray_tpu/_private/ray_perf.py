@@ -578,6 +578,289 @@ print(json.dumps(out))
 """
 
 
+_DATA_PLANE_CODE = """
+import json, os, time
+
+# Cap XLA's CPU intra-op thread pool BEFORE anything imports jax (the
+# raylets/workers inherit it): on this simulated 2-host box the gang
+# step's XLA threads would otherwise timeshare the SAME cores the
+# producer tasks need — on real hardware the step runs on TPU cores,
+# not host CPUs. Applies equally to the streaming and prestaged legs
+# (fair A/B).
+os.environ.setdefault(
+    "XLA_FLAGS",
+    "--xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=2",
+)
+# Core separation, the CPU-box stand-in for "the step runs on TPU
+# cores, ingest on host CPUs": everything spawned from here (raylets,
+# workers, the coordinator actor) inherits the UPPER half of the
+# machine; rank processes re-pin to the lower half (_pin below). On a
+# small box the pin is a no-op and the measurement simply carries the
+# timeshare noise.
+try:
+    _ncpu = os.cpu_count() or 0
+    if _ncpu >= 16:
+        os.sched_setaffinity(0, set(range(_ncpu // 2, _ncpu)))
+except Exception:
+    pass
+import numpy as np
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu._private import rpc
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.mesh import MeshGroup
+
+out = {}
+
+# ---- leg 1: streaming ingest into a RUNNING 2-host gang ----
+# 16 columnar 4 MiB blocks (64 MiB) are produced placement-routed onto
+# the rank-host that consumes them, prefetched over the zero-copy pull
+# plane, and fed through a compiled per-rank step timed SYNCHRONOUSLY
+# (block_until_ready per step — "step time" is only observable when
+# each step completes before the next batch is demanded); the gate
+# compares the epoch wall against the SAME compute over pre-staged
+# local batches.
+N_BLOCKS, ROWS_PER, DIM = 16, 4096, 256  # 4 MiB per block
+
+c = Cluster(
+    initialize_head=True,
+    head_node_args={"resources": {"CPU": 6}},
+    system_config={
+        "prestart_workers": False,
+        "log_to_driver": False,
+        # ingest tasks soft-pin to rank hosts whose slots breathe with
+        # the pipeline: spill off a transiently-saturated hint fast —
+        # the bench epoch is short, 200 ms of parked locality is a stall
+        "soft_affinity_spill_after_s": 0.05,
+    },
+)
+try:
+    c.add_node(num_cpus=6)
+    c.connect()
+    mg = MeshGroup(hosts=2, mesh_shape={"dp": 2}, devices_per_host=1,
+                   name="data_plane_gang")
+
+    def make_block(b, _r=ROWS_PER, _d=DIM):
+        import numpy as np
+        return {"x": np.full((_r, _d), float(b[0]), np.float32)}
+
+    def build_ds():
+        return rd.from_items(
+            list(range(N_BLOCKS)), parallelism=N_BLOCKS
+        ).map_batches(make_block)
+
+    def _pin(rank):
+        # pin this rank's process to its own quarter of the machine's
+        # LOWER half (both legs, fair A/B — the driver pinned the infra
+        # plane to the upper half before the cluster spawned): the
+        # CPU-box stand-in for "the step runs on TPU cores, ingest on
+        # host CPUs". Without it, XLA's step threads timeshare the
+        # exact cores the producer tasks need and the measurement
+        # conflates the two planes.
+        import os as _os
+        try:
+            ncpu = _os.cpu_count() or 0
+            if ncpu >= 16:
+                per = (ncpu // 2) // 2
+                _os.sched_setaffinity(
+                    0, set(range(rank * per, (rank + 1) * per))
+                )
+        except Exception:
+            pass
+
+    def _make_step():
+        # FLOP-dense, cache-resident step body (one pass over the
+        # batch, then square matmuls on a 512x512 working set) at
+        # ~100 ms — the training-step shape (that is what TPUs are
+        # for), NOT a bandwidth sweep re-reading the batch every
+        # iteration: a bandwidth-bound "step" measures the box's
+        # memory bus against ingest's copies, not ingest overlap
+        import jax
+
+        @jax.jit
+        def step(acc, x):
+            y = x.reshape(-1, 512)
+            w = y.T @ y * 1e-3
+            for _ in range(96):
+                w = w @ w * 1e-6 + w
+            return acc + w.sum()
+
+        return step
+
+    def epoch_streaming(ctx, its, bsz):
+        import time
+        from itertools import chain
+        import jax, jax.numpy as jnp
+        _pin(ctx.rank)
+        it = its[ctx.rank]
+        step = _make_step()
+        acc = step(jnp.zeros(()), jnp.zeros((bsz, {DIM}), jnp.float32))
+        jax.block_until_ready(acc)  # compile off the clock
+        gen = it.iter_device_batches(batch_size=bsz,
+                                     prefetch_batches=2,
+                                     prefetch_blocks=4)
+        first = next(gen)  # pipeline priming off the clock (fill
+        # latency is a constant, sustained ingest is the contract; the
+        # primed batch's STEP still runs on the clock below)
+        rows = 0
+        nbytes = 0
+        t0 = time.perf_counter()
+        for batch in chain([first], gen):
+            x = batch["x"]
+            rows += int(x.shape[0])
+            nbytes += int(x.size) * 4
+            acc = step(acc, x)
+            jax.block_until_ready(acc)  # sync step: stall lands HERE,
+            # between steps, never hidden inside the async dispatch queue
+        wall = time.perf_counter() - t0
+        return {"rows": rows, "bytes": nbytes, "wall": wall,
+                "ingest": it.stats()["prefetch"]}
+
+    def epoch_prestaged(ctx, steps, bsz):
+        import time
+        import jax, jax.numpy as jnp
+        import numpy as np
+        _pin(ctx.rank)
+        step = _make_step()
+        batches = [np.full((bsz, {DIM}), float(i), np.float32)
+                   for i in range(steps)]
+        acc = step(jnp.zeros(()), jnp.zeros((bsz, {DIM}), jnp.float32))
+        jax.block_until_ready(acc)
+        t0 = time.perf_counter()
+        for x in batches:
+            acc = step(acc, x)
+            jax.block_until_ready(acc)
+        return {"wall": time.perf_counter() - t0}
+
+    # one untimed streaming epoch first: worker-process spawn, the
+    # coordinator actor, and jit caches all warm OFF the clock — the
+    # gate measures steady-state ingest (a real training job's epoch
+    # 2+), not process cold-start
+    its = mg.split_dataset(build_ds())
+    mg.run(epoch_streaming, its, ROWS_PER)
+    for it in its:
+        it.stop()
+        break
+    pre_wall = None
+    for _ in range(3):  # best-of-3 BOTH legs: a noisy single-sample
+        # baseline would skew the gated ratio in either direction
+        pre = mg.run(epoch_prestaged, N_BLOCKS // 2, ROWS_PER)
+        w = max(r["wall"] for r in pre)
+        pre_wall = w if pre_wall is None else min(pre_wall, w)
+    best = None
+    for _ in range(3):  # best-of-3: shared-box noise vs a 5% gate
+        its = mg.split_dataset(build_ds())
+        res = mg.run(epoch_streaming, its, ROWS_PER)
+        for it in its:
+            it.stop()
+            break  # one stop kills the shared coordinator
+        wall = max(r["wall"] for r in res)
+        if best is None or wall < best[0]:
+            best = (wall, res)
+    stream_wall, res = best
+    rows = sum(r["rows"] for r in res)
+    nbytes = sum(r["bytes"] for r in res)
+    assert rows == N_BLOCKS * ROWS_PER, (rows, res)
+    out["rows_per_s"] = round(rows / stream_wall, 1)
+    out["bytes_per_s"] = round(nbytes / stream_wall, 1)
+    out["epoch_wall_s"] = round(stream_wall, 3)
+    out["prestaged_wall_s"] = round(pre_wall, 3)
+    out["step_delta"] = round(stream_wall / pre_wall - 1.0, 4)
+    out["ingest_stall_s"] = round(
+        max(r["ingest"]["ingest_stall_s"] for r in res), 4
+    )
+    mg.shutdown()
+finally:
+    c.shutdown()
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+
+# ---- leg 2: hot-partition shuffle over the broadcast machinery ----
+# One 24 MiB source block shuffles into 4 partitions: the packed
+# partition output is pulled by all 4 merges (routed one per node), so
+# the holder's egress must stay O(tree fanout), not O(consumers).
+SIZE_MB, K = 24, 4
+
+
+def shuffle_leg(fanout):
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2, "head": 1}},
+        system_config={
+            "prestart_workers": False,
+            "log_to_driver": False,
+            "object_transfer_same_host_shm": False,  # measure the NIC plane
+            "object_broadcast_min_bytes": 4 * 1024 * 1024,
+            "object_broadcast_fanout": fanout,
+        },
+    )
+    try:
+        nodes = [c.add_node(num_cpus=1, resources={f"p{i}": 1})
+                 for i in range(K)]
+        c.connect()
+        from ray_tpu.data.shuffle import shuffle_stage
+        from ray_tpu.data.streaming import StreamingExecutor
+
+        arr = np.arange(SIZE_MB * 1024 * 1024 // 4, dtype=np.float32)
+        ds = rd.from_numpy(arr, parallelism=1)
+        info = {n["node_id"].hex(): n for n in ray_tpu.nodes()}
+        clis = {nid: rpc.Client.connect(ni["raylet_addr"], name="dp-" + nid[:6])
+                for nid, ni in info.items()}
+        base = {nid: cl.call("node_stats", None, timeout=30)["transfer"]
+                for nid, cl in clis.items()}
+        ex = StreamingExecutor(
+            [shuffle_stage(K, seed=7)], ds._source_refs,
+            locality_hints=[n.node_id.hex() for n in nodes],
+        )
+        t0 = time.perf_counter()
+        got = sum(1 for _ in ex.iter_output_refs())
+        wall = time.perf_counter() - t0
+        assert got == K, got
+        after = {nid: cl.call("node_stats", None, timeout=30)["transfer"]
+                 for nid, cl in clis.items()}
+        egress = {nid: after[nid]["bytes_out"] - base[nid]["bytes_out"]
+                  for nid in after}
+        tree_pulls = sum(after[nid]["tree_pulls"] - base[nid]["tree_pulls"]
+                        for nid in after)
+        for cl in clis.values():
+            cl.close()
+        return max(egress.values()) / arr.nbytes, tree_pulls, wall
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+ratio_tree, tree_pulls, wall_tree = shuffle_leg(2)
+ratio_naive, _, wall_naive = shuffle_leg(0)
+out["shuffle_egress_ratio"] = round(ratio_tree, 2)
+out["shuffle_egress_ratio_naive"] = round(ratio_naive, 2)
+out["shuffle_consumers"] = K
+out["shuffle_tree_pulls"] = tree_pulls
+out["shuffle_wall_s"] = round(wall_tree, 3)
+print(json.dumps(out))
+"""
+
+
+def run_data_plane_bench() -> Dict[str, float]:
+    """Streaming data plane (r12): sustained rows/s + bytes/s of
+    placement-routed, prefetched ingest into a RUNNING 2-host gang with
+    the step-time delta vs pre-staged local data (the "ingest never
+    blocks the step" contract), plus the hot-partition shuffle leg —
+    the packed partition block's holder egress with K merge consumers,
+    tree on vs off (sub-linear-in-consumers proof). Subprocess-isolated
+    like the transfer bench."""
+    return _run_isolated(
+        "data plane",
+        _DATA_PLANE_CODE.replace("{DIM}", "256"),
+        timeout=600,
+    )
+
+
 def run_gcs_plane_bench() -> Dict[str, float]:
     """Control-plane micro (r11): mutations/s through the RPC plane
     against the file-backed GCS (group-commit journal), the group-commit

@@ -357,6 +357,11 @@ class Raylet:
         # locality-aware stripe-peer picks: pulls whose first-choice
         # source shared this node's host (or gang) label
         self._locality_pref_hits = 0
+        # cumulative remote fetches that materialized a local copy
+        # (contains/restore hits excluded): the data plane's re-read
+        # accounting rides this — after a node death, the delta must
+        # match only the LOST shards, never the whole epoch
+        self._pulls_completed = 0
         # GCS read cache (r11): object-location entries enter on a
         # directory read (populate-on-miss — a first-time puller still
         # registers with the broadcast-tree registry) and are
@@ -1035,9 +1040,12 @@ class Raylet:
             target = self.cluster_nodes.get(target_hex)
             alive = target is not None and target.get("alive", True)
             if target_hex != me:
-                if alive:
+                if alive and (not soft or hops == 0):
+                    # soft + hops>0 means the TARGET already declined us
+                    # (saturated): serve as default traffic here instead
+                    # of ping-ponging back
                     return {"spillback": target["raylet_addr"]}
-                if not soft:
+                if not alive and not soft:
                     # Hard affinity to a missing node: park (it may rejoin),
                     # expire to an explicit infeasible error.
                     fut = asyncio.get_running_loop().create_future()
@@ -1050,10 +1058,57 @@ class Raylet:
                 # soft: fall through to default placement
             else:
                 if self._feasible(resources):
-                    fut = asyncio.get_running_loop().create_future()
-                    self.lease_queue.append((summary, fut, conn))
+                    if not soft or self._can_fit_with_queue(resources):
+                        fut = asyncio.get_running_loop().create_future()
+                        self.lease_queue.append((summary, fut, conn))
+                        self._watch_owner(conn)
+                        self._pump_lease_queue()
+                        return await fut
+                    # SOFT affinity to a feasible-but-saturated node
+                    # (r12): queue — transient saturation (another data
+                    # task finishing in a few ms) must keep locality —
+                    # but with a SPILL DEADLINE: if still ungranted
+                    # after soft_affinity_spill_after_s, move to an idle
+                    # peer. Unbounded queueing here deadlocks outright
+                    # when the pinned host's slots are held by
+                    # long-lived actors that WAIT on this task's output
+                    # (the data plane's consumers do exactly that). The
+                    # spilled request carries hops>0, so the peer serves
+                    # it as default traffic instead of bouncing it back.
+                    loop = asyncio.get_running_loop()
+                    fut = loop.create_future()
+                    entry = (summary, fut, conn)
+                    self.lease_queue.append(entry)
                     self._watch_owner(conn)
                     self._pump_lease_queue()
+
+                    def _spill_if_stuck():
+                        if fut.done() or entry not in self.lease_queue:
+                            return  # granted / mid-grant: leave it be
+                        spill = self._pick_spillback(resources,
+                                                     strict=False)
+                        if spill:
+                            # remove only once a target exists: a
+                            # remove/re-append round trip would send the
+                            # entry to the FIFO tail each interval and
+                            # starve it behind newer leases
+                            try:
+                                self.lease_queue.remove(entry)
+                            except ValueError:
+                                return
+                            fut.set_result({"spillback": spill})
+                            return
+                        # nowhere better: keep waiting IN PLACE, re-check
+                        self._pump_lease_queue()
+                        loop.call_later(
+                            GLOBAL_CONFIG.soft_affinity_spill_after_s,
+                            _spill_if_stuck,
+                        )
+
+                    loop.call_later(
+                        GLOBAL_CONFIG.soft_affinity_spill_after_s,
+                        _spill_if_stuck,
+                    )
                     return await fut
                 if not soft:
                     fut = asyncio.get_running_loop().create_future()
@@ -1779,6 +1834,8 @@ class Raylet:
         self._pulls_inflight[oid_bytes] = fut
         try:
             ok = await self._pull_object_once(oid, oid_bytes)
+            if ok:
+                self._pulls_completed += 1
             if not fut.done():
                 fut.set_result(ok)
             return ok
@@ -2838,6 +2895,10 @@ class Raylet:
                 "last_pull_gbps": self._last_pull_gbps,
                 "chunks_inflight": self._pull_chunks_inflight,
                 "pulls_inflight": len(self._pulls_inflight),
+                # remote fetches that landed a local copy (dedup'd: N
+                # waiters on one in-flight pull count once) — the data
+                # plane's re-read/transfer accounting
+                "pulls_completed": self._pulls_completed,
                 "pull_aborts": self._pull_aborts,
                 "chunk_retries": self._transfer_chunk_retries,
                 "peer_conns": self._peer_pool.stats(),

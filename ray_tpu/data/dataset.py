@@ -382,8 +382,24 @@ class Dataset:
 
         return explain(self)
 
-    def iter_native_blocks(self, **kw) -> Iterator:
-        """Blocks in their stored form (row list or columnar dict)."""
+    def iter_native_blocks(self, prefetch_blocks: int = 0,
+                           **kw) -> Iterator:
+        """Blocks in their stored form (row list or columnar dict).
+        ``prefetch_blocks`` > 0 resolves upcoming blocks ahead of the
+        consumer via the per-host prefetch agent (lag-bounded; see
+        data/prefetch.py)."""
+        if prefetch_blocks and prefetch_blocks > 0:
+            from ray_tpu.data.prefetch import BlockPrefetcher
+
+            pf = BlockPrefetcher(
+                self._executor(**kw).iter_output_refs(),
+                max_ahead=prefetch_blocks,
+            )
+            try:
+                yield from pf
+            finally:
+                pf.close()
+            return
         for ref in self._executor(**kw).iter_output_refs():
             yield ray_tpu.get(ref)
 
@@ -397,21 +413,27 @@ class Dataset:
             yield from BlockAccessor.for_block(block).iter_rows()
 
     def iter_batches(self, batch_size: int = 256,
-                     batch_format: str = "rows", **kw) -> Iterator:
+                     batch_format: str = "rows",
+                     prefetch_blocks: int = 0, **kw) -> Iterator:
         return batches_from_blocks(
-            self.iter_native_blocks(**kw), batch_size, batch_format
+            self.iter_native_blocks(prefetch_blocks=prefetch_blocks, **kw),
+            batch_size, batch_format,
         )
 
     def iter_device_batches(self, batch_size: int = 256, *,
                             prefetch_batches: int = 2,
+                            prefetch_blocks: int = 2,
                             sharding=None) -> Iterator:
         """Double-buffered ``jax.device_put`` batch feed — see
         DataIterator.iter_device_batches (same contract, single
-        consumer)."""
+        consumer; block prefetch ON by default)."""
         from ray_tpu.data.iterator import _device_batches
 
         return _device_batches(
-            lambda: self.iter_batches(batch_size, batch_format="numpy"),
+            lambda: self.iter_batches(
+                batch_size, batch_format="numpy",
+                prefetch_blocks=prefetch_blocks,
+            ),
             prefetch_batches, sharding,
         )
 
@@ -449,17 +471,33 @@ class Dataset:
 
     # ---------------- split ----------------
 
-    def streaming_split(self, n: int) -> List["DataIterator"]:
+    def streaming_split(self, n: int,
+                        locality_hints: Optional[List[str]] = None,
+                        gang: Optional[str] = None,
+                        ) -> List["DataIterator"]:
         """N per-consumer iterators fed round-robin from ONE streaming
         execution (reference dataset.py:1125 / stream_split_iterator.py:31).
         Blocks flow through a coordinator actor so consumers can live in
-        different worker processes (e.g. JaxTrainer workers)."""
+        different worker processes (e.g. JaxTrainer workers).
+
+        ``locality_hints``: rank-ordered node ids (one per split) —
+        split ``i``'s blocks are PRODUCED on ``hints[i]``, so consumer
+        ``i``'s reads are same-host zero-copy maps instead of cross-node
+        pulls (a consuming MeshGroup passes its members; see
+        ``MeshGroup.split_dataset``). ``gang``: keeps the earlier,
+        shard-agnostic stages on gang-labeled hosts."""
         from ray_tpu.data.iterator import DataIterator, _SplitCoordinator
 
         import builtins
 
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have one node per split: got "
+                f"{len(locality_hints)} hints for {n} splits"
+            )
         coord_cls = ray_tpu.remote(num_cpus=0.1)(_SplitCoordinator)
-        coord = coord_cls.remote(self._source_refs, self._stages, n)
+        coord = coord_cls.remote(self._source_refs, self._stages, n,
+                                 locality_hints, gang)
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def __repr__(self):

@@ -212,11 +212,40 @@ def from_numpy(arrays, parallelism: int = 8):
     return Dataset(refs or [ray_tpu.put([])])
 
 
-def _table_to_block(table):
+def _column_to_numpy(col):
+    """Arrow column -> numpy WITHOUT the blanket copy: a single-chunk
+    primitive column with no nulls is already a contiguous aligned
+    buffer, so ``zero_copy_only=True`` hands back a view over Arrow's
+    memory (multi-chunk columns pay one unavoidable concat via
+    ``combine_chunks`` first). Strings/nulls/nested types fall back to
+    the copying path — Arrow raises rather than silently copying.
+
+    CONTRACT: zero-copy blocks are READ-ONLY views (writeable=False,
+    backed by immutable Arrow memory) — reference ray.data batch
+    semantics. A transform mutating columns in place must copy first
+    (``np.array(batch["x"])``)."""
     import numpy as np
 
+    try:
+        chunk = None
+        if col.num_chunks == 1:
+            chunk = col.chunk(0)
+        elif col.num_chunks > 1:
+            # one contiguous buffer (a single memcpy); newer pyarrow
+            # returns a plain Array here, older a 1-chunk ChunkedArray
+            chunk = col.combine_chunks()
+            if hasattr(chunk, "num_chunks"):
+                chunk = chunk.chunk(0) if chunk.num_chunks == 1 else None
+        if chunk is not None:
+            return chunk.to_numpy(zero_copy_only=True)
+    except Exception:  # ArrowInvalid: needs a conversion copy
+        pass
+    return np.asarray(col.to_numpy(zero_copy_only=False))
+
+
+def _table_to_block(table):
     return {
-        name: np.asarray(col.to_numpy(zero_copy_only=False))
+        name: _column_to_numpy(col)
         for name, col in zip(table.column_names, table.columns)
     }
 

@@ -17,7 +17,6 @@ permutation shuffles) — no per-row Python on array data.
 
 from __future__ import annotations
 
-import random as _random
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -45,8 +44,26 @@ def _take_parts(acc: BlockAccessor, assignment: np.ndarray,
 # ---------------- random shuffle ----------------
 
 
+_shuffle_seq = 0
+
+
+def _draw_shuffle_seed() -> int:
+    """Unseeded-shuffle base seed: drawn from the chaos-seeded RNG (plus
+    a process-local sequence) so a replayed workload shuffles — and
+    therefore partitions, pulls and spills — identically under the same
+    fault schedule (raylint R4's ``data/`` prong enforces this). Without
+    a chaos plane it is OS-seeded, i.e. a plain random shuffle."""
+    from ray_tpu._private import chaos
+
+    global _shuffle_seq
+    _shuffle_seq += 1
+    return chaos.replay_rng(
+        f"data:shuffle:{_shuffle_seq}"
+    ).randrange(1 << 30)
+
+
 def shuffle_stage(nparts: int, seed: Optional[int]) -> ExchangeStage:
-    base = seed if seed is not None else _random.randrange(1 << 30)
+    base = seed if seed is not None else _draw_shuffle_seed()
 
     def make_partition(_metas):
         def partition(block, idx, _n=nparts, _s=base):

@@ -32,11 +32,34 @@ the cap so a full reorder buffer can't deadlock behind one straggler.
 TPU shape: the terminal consumer is typically a host feeding
 ``jax.device_put`` / ``make_array_from_process_local_data``; keeping the
 object plane as the buffer means host RAM, not HBM, absorbs burstiness.
+
+Fast-plane composition (r12):
+
+- **Placement-aware block routing** — ``locality_hints`` (rank-ordered
+  node ids, e.g. a consuming ``MeshGroup``'s members) soft-pin the
+  ordered tail of the pipeline so output block ``idx`` is PRODUCED on
+  the host that will consume shard ``idx % n``: the consumer's ``get``
+  is then a same-arena zero-copy map, not a cross-node transfer. Stages
+  before the last exchange (no stable shard mapping) stay inside the
+  consuming gang via a soft ``raytpu.io/gang`` label constraint
+  (``gang=``), so intermediate blocks ride the same-host/same-gang
+  locality classes the stripe-peer picker already prefers.
+- **Packed exchanges** — a partition task's P outputs land as ONE
+  contiguous packed block instead of P per-column refs; every merge of
+  the exchange then pulls the SAME object and slices its partition out.
+  K merges of a hot partition block ride the transient pull registry /
+  partial-serve broadcast tree (PR 5), costing the producing node
+  ~O(tree fanout) egress instead of K point reads. Wide exchanges
+  (nparts > ``data_exchange_packed_max_parts``) keep the per-column
+  shape, where moving only 1/P of each input per merge is cheaper than
+  the tree.
 """
 
 from __future__ import annotations
 
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
 
 import ray_tpu
 
@@ -100,7 +123,7 @@ class ExchangeStage:
     def __init__(self, name: str, nparts: int,
                  make_partition: Callable[[Dict[int, Any]], Callable],
                  merge_fn: Callable, prepare_fn: Optional[Callable] = None,
-                 num_cpus: float = 1.0):
+                 num_cpus: float = 1.0, packed: Optional[bool] = None):
         if nparts < 1:
             raise ValueError("nparts must be >= 1")
         self.name = name
@@ -109,6 +132,19 @@ class ExchangeStage:
         self.merge_fn = merge_fn
         self.prepare_fn = prepare_fn
         self.num_cpus = num_cpus
+        # None = decide by width (see module docstring): narrow exchanges
+        # pack so hot partition blocks ride the broadcast tree, wide ones
+        # keep per-column refs
+        self.packed = packed
+
+    def is_packed(self) -> bool:
+        if self.packed is not None:
+            return self.packed
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        return self.nparts <= int(
+            GLOBAL_CONFIG.data_exchange_packed_max_parts
+        )
 
     def __repr__(self):
         return f"ExchangeStage({self.name}, P={self.nparts})"
@@ -157,6 +193,57 @@ def _run_merge(merge_fn, p, *parts):
     return merge_fn(p, *parts)
 
 
+def _run_partition_packed(partition_fn, idx, nparts, block):
+    """Packed-exchange partition body: the P parts land as ONE contiguous
+    block plus a row-offset table, stored once — every merge of this
+    exchange pulls this single (possibly hot) object and the concurrent
+    pulls ride the broadcast tree instead of P point reads."""
+    from ray_tpu.data.block import BlockAccessor
+
+    parts = partition_fn(block, idx)
+    if len(parts) != nparts:
+        raise ValueError(
+            f"partition_fn returned {len(parts)} parts, expected {nparts}"
+        )
+    offsets = [0]
+    for part in parts:
+        offsets.append(
+            offsets[-1] + BlockAccessor.for_block(part).num_rows()
+        )
+    return offsets, BlockAccessor.concat(list(parts))
+
+
+def _slice_packed_part(packed, p):
+    """Materialize partition ``p`` out of one packed block: a plain slice
+    would be a VIEW pinning the whole packed object in the store for the
+    merge's lifetime — copy out only the partition's rows instead."""
+    from ray_tpu.data.block import BlockAccessor
+
+    offsets, block = packed
+    part = BlockAccessor.for_block(block).slice(offsets[p], offsets[p + 1])
+    if isinstance(part, dict):
+        return {k: np.array(v) for k, v in part.items()}
+    return list(part)
+
+
+def _run_merge_packed(merge_fn, p, packed_refs):
+    """Packed-exchange merge body: fetches the packed partition blocks
+    ONE AT A TIME (each ``get`` is a locality-aware windowed striped pull
+    — deposit sinks wire->arena — deduplicated against sibling merges by
+    the local store and tree-assembled by the pull registry when the
+    block is hot), slices out partition ``p``, and drops the shm pin
+    before the next pull so a store smaller than the exchange still
+    flows by eviction/spilling."""
+    import ray_tpu
+
+    parts = []
+    for ref in packed_refs:
+        packed = ray_tpu.get(ref)
+        parts.append(_slice_packed_part(packed, p))
+        del packed  # release the packed block's pin before the next pull
+    return merge_fn(p, *parts)
+
+
 # ---------------- executor ----------------
 
 _MAP, _EXCHANGE = "map", "exchange"
@@ -185,6 +272,7 @@ class _OpState:
         self.partition_task = None
         self.merge_task = None
         self.merges_launched = 0
+        self.merges_done = 0
         self.merge_order: Optional[List[int]] = None  # sorted input idxs
 
     def done(self) -> bool:
@@ -204,6 +292,13 @@ class StreamingExecutor:
     ``max_buffered_blocks``: per-map-stage output-queue cap — the
     backpressure valve. Exchange partition outputs are exempt (the
     all-to-all footprint is inherent and spillable; see module docstring).
+
+    ``locality_hints``: rank-ordered node ids (hex) — output block
+    ``idx`` (and the 1:1 tail producing it) is soft-pinned to
+    ``hints[idx % n]``, the host consuming shard ``idx % n``.
+    ``gang``: a MeshGroup name — stages with no stable shard mapping get
+    a soft ``raytpu.io/gang`` label constraint so intermediate blocks
+    stay on gang hosts.
     """
 
     def __init__(
@@ -212,9 +307,18 @@ class StreamingExecutor:
         source_blocks: List[Any],  # ObjectRefs of input blocks
         max_tasks_in_flight: int = 4,
         max_buffered_blocks: int = 4,
+        locality_hints: Optional[List[str]] = None,
+        gang: Optional[str] = None,
     ):
         self.max_in_flight = max_tasks_in_flight
         self.max_buffered = max_buffered_blocks
+        self._hints = [
+            h.hex() if isinstance(h, bytes) else str(h)
+            for h in (locality_hints or [])
+        ]
+        self._gang = gang
+        self._routed_launches = 0  # shard-pinned task launches (tests)
+        self._task_memo: Dict[Any, Any] = {}  # see _task_for
         self.ops = [_OpState(s, i) for i, s in enumerate(stages)]
         self._source = list(enumerate(source_blocks))
         self._no_op_outputs: List[Tuple[int, Any]] = []
@@ -308,6 +412,60 @@ class StreamingExecutor:
         return (op.index >= self._ordered_from - 1
                 and op.merges_launched == self._next_idx)
 
+    # -- placement-aware routing --
+
+    def _placement(self, idx, tail: bool):
+        """(strategy, memo-key) for a task launch. Tail tasks (the 1:1
+        ordered chain producing output block ``idx``) are soft-pinned to
+        the host consuming shard ``idx % n`` — the block lands in that
+        host's store arena, so the consumer's ``get`` is a same-host
+        zero-copy map. Stages with no stable shard mapping (pre-exchange
+        maps, prepare/partition tasks) get the soft ``raytpu.io/gang``
+        label instead, keeping their blocks in locality classes 0/1.
+        Soft means soft: a saturated or lost hint node degrades to
+        default placement, never an infeasible task."""
+        if tail and self._hints:
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+
+            h = self._hints[idx % len(self._hints)]
+            return NodeAffinitySchedulingStrategy(h, soft=True), ("s", h)
+        if self._gang:
+            from ray_tpu._private.protocol import LABEL_GANG
+            from ray_tpu.util.scheduling_strategies import (
+                NodeLabelSchedulingStrategy,
+            )
+
+            return NodeLabelSchedulingStrategy(
+                soft={LABEL_GANG: [self._gang]}
+            ), ("g",)
+        return None, None
+
+    def _task_for(self, body, num_cpus, idx=None, tail: bool = False,
+                  num_returns=None):
+        """Memoized task wrapper: a fresh ``ray_tpu.remote()(body)`` +
+        ``.options()`` per launch is measurable per-task Python at
+        ingest rates, and placement is a pure function of
+        (body, idx % n, gang) — so the handful of distinct wrappers is
+        built once and reused for the whole execution."""
+        if tail and self._hints:
+            self._routed_launches += 1
+        strat, skey = self._placement(idx, tail)
+        key = (id(body), float(num_cpus), skey, num_returns)
+        task = self._task_memo.get(key)
+        if task is None:
+            task = ray_tpu.remote(num_cpus=num_cpus)(body)
+            opts = {}
+            if strat is not None:
+                opts["scheduling_strategy"] = strat
+            if num_returns is not None:
+                opts["num_returns"] = num_returns
+            if opts:
+                task = task.options(**opts)
+            self._task_memo[key] = task
+        return task
+
     def _launch(self, i: int):
         op = self.ops[i]
         if op.kind == _MAP:
@@ -323,6 +481,8 @@ class StreamingExecutor:
         k = min(range(len(op.inputs)), key=lambda j: op.inputs[j][0])
         idx, block_ref = op.inputs.pop(k)
         if st.compute is not None:
+            # pool actors are long-lived and shared across shards: no
+            # per-block routing (the pool amortizes state, not locality)
             if not op.pool:
                 actor_cls = ray_tpu.remote(num_cpus=st.num_cpus)(_PoolWorker)
                 op.pool = [
@@ -335,7 +495,10 @@ class StreamingExecutor:
             op.pool_load[a] += 1
             op.inflight[out_ref] = ("map", idx, a)
             return
-        task = ray_tpu.remote(num_cpus=st.num_cpus)(_run_stage_fn)
+        task = self._task_for(
+            _run_stage_fn, st.num_cpus, idx=idx,
+            tail=op.index >= self._ordered_from,
+        )
         out_ref = task.remote(st.fn, st.batch_format, st.with_index, idx,
                               block_ref)
         op.inflight[out_ref] = ("map", idx, None)
@@ -345,7 +508,7 @@ class StreamingExecutor:
         if op.phase == "prepare":
             idx, ref = op.inputs.pop(0)
             op.held.append((idx, ref))
-            task = ray_tpu.remote(num_cpus=st.num_cpus)(st.prepare_fn)
+            task = self._task_for(st.prepare_fn, st.num_cpus)
             sig = task.remote(ref)
             op.inflight[sig] = ("prepare", idx)
             return
@@ -354,9 +517,14 @@ class StreamingExecutor:
                 idx, ref = op.inputs.pop(0)
             else:
                 idx, ref = op.held.pop(0)
-            task = ray_tpu.remote(num_cpus=st.num_cpus)(
-                _run_partition
-            ).options(num_returns=st.nparts)
+            if st.is_packed():
+                task = self._task_for(_run_partition_packed, st.num_cpus)
+                pref = task.remote(op.partition_fn, idx, st.nparts, ref)
+                op.parts[idx] = [pref]
+                op.inflight[pref] = ("part", idx, ref)
+                return
+            task = self._task_for(_run_partition, st.num_cpus,
+                                  num_returns=st.nparts)
             out = task.remote(op.partition_fn, idx, st.nparts, ref)
             refs = [out] if st.nparts == 1 else list(out)
             op.parts[idx] = refs
@@ -364,11 +532,24 @@ class StreamingExecutor:
             # until the partition task has consumed it
             op.inflight[refs[0]] = ("part", idx, ref)
             return
-        # merge
+        # merge. The LAST exchange's merge p IS output block p: route it
+        # to the consuming shard's host.
         p = op.merges_launched
         op.merges_launched += 1
+        tail = op.index == self._ordered_from - 1
+        if st.is_packed():
+            # every merge reads the SAME packed blocks: pass the refs as
+            # a VALUE (not auto-resolved args) so the merge task pulls
+            # them one at a time — concurrent merges of a hot packed
+            # block then form a broadcast tree instead of K point reads
+            refs = [op.parts[j][0] for j in op.merge_order]
+            task = self._task_for(_run_merge_packed, st.num_cpus,
+                                  idx=p, tail=tail)
+            sig = task.remote(st.merge_fn, p, refs)
+            op.inflight[sig] = ("merge", p)
+            return
         cols = [op.parts[j][p] for j in op.merge_order]
-        task = ray_tpu.remote(num_cpus=st.num_cpus)(_run_merge)
+        task = self._task_for(_run_merge, st.num_cpus, idx=p, tail=tail)
         sig = task.remote(st.merge_fn, p, *cols)
         op.inflight[sig] = ("merge", p)
 
@@ -393,10 +574,18 @@ class StreamingExecutor:
         elif kind == "merge":
             p = meta[1]
             op.outputs.append((p, sig))
-            # free this partition column: its refs are no longer needed
-            for j in list(op.parts):
-                if p < len(op.parts[j]):
-                    op.parts[j][p] = None
+            op.merges_done += 1
+            if op.stage.is_packed():
+                # every merge reads every packed block: the refs free
+                # together once the LAST merge has consumed them
+                if op.merges_done >= op.stage.nparts:
+                    op.parts.clear()
+            else:
+                # free this partition column: its refs are no longer
+                # needed
+                for j in list(op.parts):
+                    if p < len(op.parts[j]):
+                        op.parts[j][p] = None
 
     def _pump(self, timeout: float = 0.2) -> bool:
         """One loop step: launch what's schedulable, harvest what finished.
@@ -412,12 +601,23 @@ class StreamingExecutor:
             (sig, op) for op in self.ops for sig in op.inflight
         ]
         if all_inflight:
+            sigs = [sig for sig, _ in all_inflight]
             ready, _ = ray_tpu.wait(
-                [sig for sig, _ in all_inflight],
+                sigs,
                 num_returns=1,
                 timeout=None if launched else timeout,
                 fetch_local=False,
             )
+            if ready:
+                # drain EVERYTHING already finished, not just the one
+                # the blocking wait returned: harvesting one completion
+                # per loop iteration made each output block pay a full
+                # launch-scan + wait round (r12: ~2x block latency at
+                # ingest rates)
+                ready, _ = ray_tpu.wait(
+                    sigs, num_returns=len(sigs), timeout=0,
+                    fetch_local=False,
+                )
             ready_set = set(ready)
             for sig, op in all_inflight:
                 if sig in ready_set:
@@ -477,6 +677,17 @@ class StreamingExecutor:
 
     def _done(self) -> bool:
         return all(op.done() for op in self.ops)
+
+    def stats(self) -> Dict[str, Any]:
+        """Executor observability: peak buffered blocks (backpressure
+        proof) and how many task launches were shard-routed to a
+        locality hint (placement proof)."""
+        return {
+            "peak_buffered": self._peak_buffered,
+            "routed_launches": self._routed_launches,
+            "hints": len(self._hints),
+            "gang": self._gang,
+        }
 
     # -- consumption --
 

@@ -795,6 +795,33 @@ class MeshGroup:
         self._publish_registry()
         return restored
 
+    # -- data-plane composition ----------------------------------------
+
+    def member_node_ids(self) -> List[str]:
+        """Rank-ordered member node ids (hex) — the shard->host map the
+        streaming data plane routes block production with."""
+        return [m["node_id"] for m in self.members]
+
+    def split_dataset(self, ds, n_per_host: int = 1) -> List:
+        """Per-rank ingest iterators for ``ds``, placement-routed onto
+        this gang: shard ``i``'s producing tasks are soft-pinned to rank
+        ``i``'s host (its consumer's reads become same-arena zero-copy
+        maps) and earlier stages stay on gang-labeled nodes via the
+        ``raytpu.io/gang`` stamp. Returns ``hosts * n_per_host``
+        :class:`~ray_tpu.data.iterator.DataIterator`\\ s in rank-major
+        order; consume them with
+        ``iter_device_batches(prefetch_blocks=...)`` so block arrival
+        (windowed striped pulls into the local arena) overlaps
+        ``run_step``."""
+        self._require_ready()
+        hints = [
+            nid for nid in self.member_node_ids()
+            for _ in range(max(1, n_per_host))
+        ]
+        return ds.streaming_split(
+            len(hints), locality_hints=hints, gang=self.name
+        )
+
     # -- observability / lifecycle -------------------------------------
 
     def stats(self) -> Dict[str, Any]:

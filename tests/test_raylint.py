@@ -321,6 +321,36 @@ def test_r4_covers_mesh_package_randomness():
     assert findings == []
 
 
+def test_r4_covers_data_package_randomness():
+    """R4's module prong extends to the whole ``ray_tpu/data/``
+    directory (r12): shuffle/partition draws decide which blocks move
+    where — and therefore which pulls, spills and re-reads a replayed
+    chaos schedule meets — so data-package code may only draw from
+    ``chaos.replay_rng``; OS-seeded ``random`` draws anywhere under the
+    directory are findings."""
+    bad = textwrap.dedent(
+        """
+        import random
+        def _draw_shuffle_seed():
+            return random.randrange(1 << 30)
+        """
+    )
+    findings, _ = lint_source(bad, "ray_tpu/data/shuffle.py")
+    assert any(f.rule == "R4" for f in findings)
+    # same code OUTSIDE the directory (and off the basename list): clean
+    findings, _ = lint_source(bad, "ray_tpu/train/augment.py")
+    assert findings == []
+    good = textwrap.dedent(
+        """
+        from ray_tpu._private import chaos
+        def _draw_shuffle_seed():
+            return chaos.replay_rng("data:shuffle").randrange(1 << 30)
+        """
+    )
+    findings, _ = lint_source(good, "ray_tpu/data/shuffle.py")
+    assert findings == []
+
+
 def test_suppression_by_rule_name_and_def_line():
     path, bad, _ = CORPUS["R1"]
     src = textwrap.dedent(bad).replace(

@@ -90,8 +90,12 @@ _R4_FILES = {"chaos.py", "rpc.py", "conduit_rpc.py", "raylet.py", "gcs.py",
 #: Whole directories under R4's module prong (matched as a path
 #: segment). ray_tpu/mesh joined in r10: gang re-placement/rendezvous
 #: retry jitter is replayed by chaos schedules — it draws from
-#: chaos.replay_rng, never the OS-seeded random module.
-_R4_DIRS = {"mesh"}
+#: chaos.replay_rng, never the OS-seeded random module. ray_tpu/data
+#: joined in r12: shuffle/partition draws decide which blocks move
+#: where (and therefore which pulls and spills a chaos schedule meets),
+#: so streaming/shuffle randomness must come from chaos.replay_rng or
+#: the replay diverges from the recorded fault schedule.
+_R4_DIRS = {"mesh", "data"}
 
 #: R4: draws on the process-global (OS-seeded) random module.
 _R4_DRAWS = {
