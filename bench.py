@@ -698,11 +698,15 @@ def main():
             ["ray_tpu", "tests", "tools"],
             root=os.path.dirname(os.path.abspath(__file__)),
         )
+        # unused suppressions (S1) are real findings and already in the
+        # list; parse errors are reported separately but gate identically
         raylint_findings = len(_lint["findings"]) + len(_lint["errors"])
         raylint_detail = {
-            "findings": raylint_findings,
+            "findings": len(_lint["findings"]),
+            "parse_errors": len(_lint["errors"]),
             "suppressed": _lint["suppressed"],
-            "counts": _lint["counts"],
+            "unused_suppressions": _lint["unused_suppressions"],
+            "by_rule": _lint["counts"],
         }
     except Exception as e:  # a broken linter must fail loudly, not pass
         raylint_findings = -1

@@ -338,7 +338,7 @@ class _GeneratorStream:
                     0.2, deadline - time.monotonic()
                 )
                 if remaining <= 0:
-                    raise TimeoutError(
+                    raise exc.GetTimeoutError(
                         "no generator item reported within timeout"
                     )
                 self._cond.wait(timeout=remaining)
@@ -790,12 +790,12 @@ class CoreWorker:
         while True:
             try:
                 return self.store.create_buffer(oid, total)
-            except StoreFullError:
+            except StoreFullError as full:
                 if not GLOBAL_CONFIG.object_spilling_enabled:
                     raise exc.OutOfMemoryError(
                         f"object store full putting {total} bytes for "
                         f"{oid.hex()} (spilling disabled)"
-                    )
+                    ) from full
                 try:
                     freed = self.raylet.call("spill_now", total, timeout=30)
                 except Exception:
@@ -810,7 +810,7 @@ class CoreWorker:
                         f"object store full putting {total} bytes for "
                         f"{oid.hex()}; spilling freed nothing (all objects "
                         f"pinned or in flight)"
-                    )
+                    ) from full
                 if not freed:
                     time.sleep(0.05)  # let the concurrent spiller finish
 
@@ -836,12 +836,12 @@ class CoreWorker:
             try:
                 buf = self.store.create_buffer(oid, total)
                 break
-            except StoreFullError:
+            except StoreFullError as full:
                 if not GLOBAL_CONFIG.object_spilling_enabled:
                     raise exc.OutOfMemoryError(
                         f"object store full putting {total} bytes for "
                         f"{oid.hex()} (spilling disabled)"
-                    )
+                    ) from full
                 try:
                     freed = await self.raylet.conn.call_async(
                         "spill_now", total, timeout=30
@@ -853,7 +853,7 @@ class CoreWorker:
                     raise exc.OutOfMemoryError(
                         f"object store full putting {total} bytes for "
                         f"{oid.hex()}; spilling freed nothing"
-                    )
+                    ) from full
                 if not freed:
                     await asyncio.sleep(0.05)
         try:
@@ -1968,7 +1968,8 @@ class CoreWorker:
                 if (
                     GLOBAL_CONFIG.native_wire
                     and GLOBAL_CONFIG.native_push_conns
-                    and _conduit_available()
+                    # may compile the shim on first call — off-loop (R7)
+                    and await asyncio.to_thread(_conduit_available)
                 ):
                     from ray_tpu._private.conduit_rpc import connect_conduit
 
@@ -3019,7 +3020,11 @@ class CoreWorker:
                                 self._stream_generator_returns, spec, result
                             )
                     else:
-                        out = self._encode_returns(spec, result)
+                        # pack + copy off the actor's asyncio loop: a large
+                        # return would stall other in-flight methods (R7)
+                        out = await asyncio.to_thread(
+                            self._encode_returns, spec, result
+                        )
                     self._emit_task_event(spec, "FINISHED")
                     return out
                 except Exception as e:  # noqa: BLE001 — shipped to caller
@@ -3459,7 +3464,9 @@ class CoreWorker:
         ack without blocking the actor's asyncio loop."""
         n = 0
         async for item in agen:
-            msg = self._encode_yield(spec, n, item)
+            # serialize off the actor loop; contained-ref tracking is
+            # thread-local and consumed inside _encode_yield itself (R7)
+            msg = await asyncio.to_thread(self._encode_yield, spec, n, item)
             fut = asyncio.run_coroutine_threadsafe(
                 self._send_gen_report(spec.owner, msg), self.io.loop
             )

@@ -398,7 +398,10 @@ class GcsServer:
             # fresh snapshot, then both journals reset — replay stays O(one
             # snapshot interval), not O(uptime)
             try:
-                self._startup_compact()
+                # fsync-bearing snapshot write; nothing serves yet, but a
+                # multi-ms stall on the loop here delays first heartbeat
+                # registration (raylint R7)
+                await asyncio.to_thread(self._startup_compact)
             except Exception:
                 logger.exception("GCS startup snapshot compaction failed")
         await self.server.start_async()
@@ -418,7 +421,11 @@ class GcsServer:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
-            self._persist_now()
+            if self.storage_path:
+                # same split as _persist_loop: consistent copy on the
+                # loop, fsync-bearing flush off it (raylint R7)
+                snap = self._snapshot()
+                await asyncio.to_thread(self._flush_snapshot, snap)
         if self._journal_w is not None:
             self._journal_w.close()
         await self.server.stop_async()

@@ -22,6 +22,7 @@ from typing import Dict, Optional
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import NodeID
+from ray_tpu.exceptions import GetTimeoutError
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
 
@@ -107,7 +108,7 @@ def _wait_addr(addr: str, timeout=30.0, proc: Optional[subprocess.Popen] = None)
                 f"process exited with {proc.returncode} before serving {addr}"
             )
         time.sleep(0.02)
-    raise TimeoutError(f"timed out waiting for {addr}")
+    raise GetTimeoutError(f"timed out waiting for {addr}")
 
 
 class NodeProcs:

@@ -145,7 +145,7 @@ class PeerConnectionPool:
     def __init__(self, name: str = "raylet-pull"):
         self.name = name
         self._conns: Dict[str, _PeerEntry] = {}
-        self._dial_locks: Dict[str, asyncio.Lock] = {}
+        self._dials: Dict[str, asyncio.Future] = {}
 
     async def acquire(self, addr: str):
         while True:
@@ -153,19 +153,43 @@ class PeerConnectionPool:
             if ent is not None and not ent.conn.closed:
                 ent.users += 1
                 return ent.conn
-            lock = self._dial_locks.setdefault(addr, asyncio.Lock())
-            async with lock:
-                ent = self._conns.get(addr)
-                if ent is not None and not ent.conn.closed:
-                    continue  # a concurrent dial won; retake fast path
-                conn = await self._dial(addr)
-                ent = _PeerEntry(conn)
-                ent.users = 1
-                self._conns[addr] = ent
-                conn.add_close_callback(
-                    lambda c, a=addr: self._on_conn_close(a, c)
-                )
-                return conn
+            fut = self._dials.get(addr)
+            if fut is None:
+                # Single-flight dial, published as a future rather than
+                # guarded by a per-addr lock: under injected partitions
+                # the connect can stall for its full timeout, and a lock
+                # held across that await would serialize every other
+                # awaiter behind one faulted link (raylint R8).
+                fut = asyncio.get_running_loop().create_future()
+                self._dials[addr] = fut
+                try:
+                    conn = await self._dial(addr)
+                    ent = _PeerEntry(conn)
+                    ent.users = 1
+                    self._conns[addr] = ent
+                    conn.add_close_callback(
+                        lambda c, a=addr: self._on_conn_close(a, c)
+                    )
+                except BaseException as e:
+                    fut.set_exception(
+                        e if isinstance(e, Exception)
+                        else ConnectionError(f"dial to {addr} cancelled")
+                    )
+                    fut.exception()  # retrieved: no warning when unawaited
+                    raise
+                else:
+                    fut.set_result(conn)
+                    return conn
+                finally:
+                    self._dials.pop(addr, None)
+            else:
+                try:
+                    # shield: cancelling one follower must not cancel the
+                    # shared dial the leader still owns
+                    await asyncio.shield(fut)
+                except Exception:
+                    continue  # leader's dial failed; retry / become leader
+                # leader installed the entry; retake the fast path
 
     def release(self, addr: str, conn, discard: bool = False):
         ent = self._conns.get(addr)
@@ -193,7 +217,11 @@ class PeerConnectionPool:
         # would drop the first frame of EVERY re-dialed conn, turning a
         # probabilistic fault into a permanent one.
         name = f"{self.name}#{os.urandom(2).hex()}"
-        if GLOBAL_CONFIG.native_wire and conduit.available():
+        # conduit.available() may compile the C++ shim on first call —
+        # off-loop (raylint R7); cached thereafter
+        if GLOBAL_CONFIG.native_wire and await asyncio.to_thread(
+            conduit.available
+        ):
             from ray_tpu._private.conduit_rpc import connect_conduit
 
             conn = await connect_conduit(addr, name=name)
@@ -392,7 +420,11 @@ class Raylet:
     async def start(self):
         self._loop = asyncio.get_running_loop()
         size = int(GLOBAL_CONFIG.object_store_memory_bytes)
-        self.store = SharedMemoryStore.create(self.store_path, size)
+        # create() may compile the native store lib on first use — off-loop
+        # (raylint R7)
+        self.store = await asyncio.to_thread(
+            SharedMemoryStore.create, self.store_path, size
+        )
         if GLOBAL_CONFIG.object_spilling_enabled:
             # full creates escalate to spill_now instead of dropping LRU data
             self.store.set_no_evict(True)
@@ -2131,10 +2163,15 @@ class Raylet:
         st = self._peer_stores.get(path)
         if st is None or st.closed:
             try:
-                st = SharedMemoryStore.attach(path)
+                # attach() may compile the native store lib — off-loop (R7)
+                st = await asyncio.to_thread(SharedMemoryStore.attach, path)
             except Exception:
                 return False
-            self._peer_stores[path] = st
+            cur = self._peer_stores.get(path)
+            if cur is not None and not cur.closed:
+                st = cur  # concurrent attacher won during the await
+            else:
+                self._peer_stores[path] = st
         view = None
         try:
             view = st.get(oid, timeout=0)  # pins cross-process
@@ -2231,8 +2268,9 @@ class Raylet:
         # asyncio fallback the frames arrive inline and sink_target
         # copies them into place instead.
         token = int.from_bytes(os.urandom(7), "big") + 1
+        # available() may compile the shim on first call — off-loop (R7)
         native_sink = bool(GLOBAL_CONFIG.native_wire and
-                           _conduit.available())
+                           await asyncio.to_thread(_conduit.available))
         if native_sink:
             _conduit.Engine.get().sink_register(token, buf)
         self._transfers[token] = sink_target

@@ -760,14 +760,14 @@ class Client:
                 try:
                     self._maybe_reconnect(timeout=2.0)
                     conn_failures = 0
-                except Exception:
+                except Exception as e:
                     # Transport won't even re-establish. A restarting GCS
                     # needs a few seconds, but a server that is GONE must
                     # not cost every caller the whole retry window.
                     conn_failures += 1
                     if conn_failures >= 4:
                         raise
-                    raise ConnectionError("reconnect failed")
+                    raise ConnectionError("reconnect failed") from e
                 return self.io.run(self.conn.call_async(
                     method, data, timeout=attempt_timeout, rid=rid
                 ))

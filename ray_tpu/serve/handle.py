@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import ray_tpu
 from ray_tpu.exceptions import (
     BackpressureError,
+    GetTimeoutError,
     ReplicaUnavailableError,
     TaskError,
 )
@@ -400,7 +401,7 @@ class _LocalFuture:
 
     def result(self, timeout: Optional[float] = 120.0):
         if not self._req.done.wait(timeout):
-            raise TimeoutError("batched request timed out")
+            raise GetTimeoutError("batched request timed out")
         if self._req.error is not None:
             raise self._req.error
         return self._req.result

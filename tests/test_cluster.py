@@ -339,7 +339,7 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
         initialize_head=True,
         head_node_args={"resources": {"CPU": 2, "head": 1}},
         system_config={
-            # 8KB chunks make the 96MB pull take seconds (thousands of
+            # 8KB chunks make the 48MB pull take seconds (thousands of
             # chunk RPCs) — the gating this test guards against must be
             # DETECTABLE, not hidden by a fast loopback transfer (the
             # same-host shm fast path is likewise disabled)
@@ -354,7 +354,7 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
 
         @ray_tpu.remote(num_cpus=1, resources={"other": 0.01})
         def make_big():
-            return np.zeros(3_000_000, np.float64)  # 24 MB on other node
+            return np.zeros(6_000_000, np.float64)  # 48 MB on other node
 
         big_ref = make_big.remote()
         ray_tpu.wait([big_ref], timeout=60, fetch_local=False)
@@ -372,7 +372,7 @@ def test_slow_arg_transfer_does_not_block_other_tasks():
         fast = quick.remote()
         assert ray_tpu.get(fast, timeout=60) == "fast"
         fast_done = time.monotonic() - t0
-        assert ray_tpu.get(slow, timeout=180) == 24_000_000
+        assert ray_tpu.get(slow, timeout=180) == 48_000_000
         slow_done = time.monotonic() - t0
         # the transfer must have been slow enough to be a meaningful gate,
         # and the quick task must have run DURING it, not after it

@@ -115,6 +115,71 @@ CORPUS = {
                     pass
         """,
     ),
+    # R7 needs the call graph: the async handler itself calls nothing
+    # blocking, the sync helper one hop down does.
+    "R7": (
+        "_private/daemon.py",
+        """
+        import time
+        def _helper():
+            time.sleep(0.5)
+        async def handler(conn, data):
+            _helper()
+            return {"ok": True}
+        """,
+        """
+        import asyncio
+        import time
+        def _helper():
+            time.sleep(0.05)
+        async def handler(conn, data):
+            await asyncio.to_thread(_helper)
+            return {"ok": True}
+        """,
+    ),
+    # R8: the awaited call resolves (via the graph) into a wire module —
+    # here the fixture lives in rpc.py itself, so the local coroutine IS
+    # the wire layer.
+    "R8": (
+        "rpc.py",
+        """
+        import asyncio
+        _lock = asyncio.Lock()
+        async def connect_async(addr):
+            return object()
+        async def acquire(addr):
+            async with _lock:
+                return await connect_async(addr)
+        """,
+        """
+        import asyncio
+        _lock = asyncio.Lock()
+        async def connect_async(addr):
+            return object()
+        async def acquire(addr):
+            conn = await connect_async(addr)
+            async with _lock:
+                _register(conn)
+            return conn
+        """,
+    ),
+    "R9": (
+        "_private/gcs_client.py",
+        """
+        def load(self):
+            try:
+                return self._read()
+            except OSError:
+                raise RuntimeError("snapshot load failed")
+        """,
+        """
+        def load(self):
+            try:
+                return self._read()
+            except OSError as e:
+                raise RuntimeError("snapshot load failed") from e
+        """,
+    ),
 }
 
 
@@ -382,11 +447,12 @@ def test_json_schema_stable(tmp_path):
     )
     report = lint_paths([str(tmp_path)])
     assert set(report) == {
-        "version", "files_checked", "findings", "suppressed", "counts",
-        "errors",
+        "version", "files_checked", "findings", "suppressed",
+        "unused_suppressions", "counts", "errors",
     }
-    assert report["version"] == 1
+    assert report["version"] == 2
     assert report["files_checked"] == 1
+    assert report["unused_suppressions"] == 0
     assert report["errors"] == []
     (finding,) = report["findings"]
     assert set(finding) == {"file", "line", "col", "rule", "name",
@@ -424,6 +490,196 @@ def test_parse_error_reported(tmp_path):
     (tmp_path / "broken.py").write_text("def oops(:\n")
     report = lint_paths([str(tmp_path)])
     assert report["errors"] and "parse error" in report["errors"][0]["error"]
+
+
+def test_r7_two_hop_chain_named_and_invisible_to_direct_logic():
+    """Acceptance fixture: the 2-hop chain (async handler -> sync helper
+    -> time.sleep) is flagged WITH the chain named in the message, and
+    the same snippet passes under the old direct-call-only rule set —
+    i.e. R7 sees something R1 provably cannot."""
+    path, bad, _ = CORPUS["R7"]
+    src = textwrap.dedent(bad)
+    findings, _ = lint_source(src, path)
+    r7 = [f for f in findings if f.rule == "R7"]
+    assert r7, [f.as_dict() for f in findings]
+    msg = r7[0].message
+    assert "handler" in msg and "_helper" in msg and "time.sleep" in msg
+    assert "->" in msg  # the full call chain is spelled out
+    # regression shape: direct-call-only logic (PR-3 era R1) is blind
+    old_findings, _ = lint_source(src, path, rules={"R1"})
+    assert old_findings == [], [f.as_dict() for f in old_findings]
+
+
+def test_r7_through_decorated_def_and_self_method():
+    """Graph coverage: the chain survives a decorator wrapper and a
+    ``self.``-method hop within the class."""
+    src = textwrap.dedent(
+        """
+        import time
+        def _retry(f):
+            return f
+        @_retry
+        def _helper():
+            time.sleep(0.5)
+        class Pump:
+            def _wait(self):
+                _helper()
+            async def run(self):
+                self._wait()
+        """
+    )
+    findings, _ = lint_source(src, "_private/pump.py")
+    r7 = [f for f in findings if f.rule == "R7"]
+    assert r7, [f.as_dict() for f in findings]
+    msg = r7[0].message
+    assert "_wait" in msg and "_helper" in msg and "time.sleep" in msg
+
+
+def test_r8_cross_module_both_lock_types(tmp_path):
+    """R8 through a real two-file index: awaits under held
+    ``asyncio.Lock`` AND ``threading.Lock`` that resolve into rpc.py
+    fire; a non-wire await under the same lock does not."""
+    (tmp_path / "rpc.py").write_text(textwrap.dedent(
+        """
+        async def connect_async(addr, timeout=10):
+            return object()
+        """
+    ))
+    (tmp_path / "pool.py").write_text(textwrap.dedent(
+        """
+        import asyncio
+        import threading
+        import rpc
+        _alock = asyncio.Lock()
+        _tlock = threading.Lock()
+        async def dial_async(addr):
+            async with _alock:
+                return await rpc.connect_async(addr)
+        async def dial_threading(addr):
+            with _tlock:
+                return await rpc.connect_async(addr)
+        async def dial_non_wire(addr):
+            async with _alock:
+                await asyncio.sleep(0)
+        """
+    ))
+    report = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert report["errors"] == []
+    r8 = [f for f in report["findings"] if f["rule"] == "R8"]
+    msgs = " | ".join(f["message"] for f in r8)
+    assert len(r8) == 2, report["findings"]
+    assert "dial_async" in msgs and "dial_threading" in msgs
+    assert "connect_async" in msgs  # resolved chain names the wire call
+    assert "dial_non_wire" not in msgs
+
+
+def test_r9_chained_and_reraise_not_flagged():
+    src = textwrap.dedent(
+        """
+        async def fetch(self):
+            try:
+                return await self._get()
+            except OSError:
+                raise
+        def load(self):
+            try:
+                return self._read()
+            except KeyError as e:
+                raise e
+        def strip(self):
+            try:
+                return self._read()
+            except OSError:
+                raise RuntimeError("context hidden on purpose") from None
+        """
+    )
+    findings, _ = lint_source(src, "_private/gcs.py")
+    assert [f for f in findings if f.rule == "R9"] == [], [
+        f.as_dict() for f in findings
+    ]
+
+
+def test_r9_untyped_timeout_raise():
+    bad = 'def wait(self):\n    raise TimeoutError("no ack")\n'
+    findings, _ = lint_source(bad, "_private/node.py")
+    assert any(f.rule == "R9" for f in findings)
+    # repo-typed subclass from exceptions.py: clean
+    good = (
+        "from ray_tpu.exceptions import GetTimeoutError\n"
+        "def wait(self):\n"
+        '    raise GetTimeoutError("no ack")\n'
+    )
+    findings, _ = lint_source(good, "_private/node.py")
+    assert findings == [], [f.as_dict() for f in findings]
+    # outside the control-plane scope the prong is silent
+    findings, _ = lint_source(bad, "ray_tpu/train/worker_group.py")
+    assert findings == []
+
+
+def test_unused_suppression_is_finding():
+    path, _, good = CORPUS["R1"]
+    src = textwrap.dedent(good).replace(
+        "await asyncio.sleep(1.0)",
+        "await asyncio.sleep(1.0)  # raylint: disable=R1 — stale",
+    )
+    findings, suppressed = lint_source(src, path)
+    assert [f.rule for f in findings] == ["S1"]
+    assert suppressed == 0
+
+
+def test_suppression_text_in_string_literal_ignored():
+    """The disable marker only counts in a real comment (tokenize), so
+    docs/fixtures that QUOTE the syntax neither suppress nor show up as
+    unused suppressions."""
+    src = 'MARKER = "# raylint: disable=R1 — quoted, not a comment"\n'
+    findings, suppressed = lint_source(src, "_private/daemon.py")
+    assert findings == []
+    assert suppressed == 0
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=str(cwd), check=True, capture_output=True, timeout=60,
+    )
+
+
+def test_changed_mode_filters_to_touched_files(tmp_path):
+    bad_dir = tmp_path / "_private"
+    bad_dir.mkdir()
+    (bad_dir / "old.py").write_text(textwrap.dedent(CORPUS["R1"][1]))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    # a second violation lands AFTER the ref
+    (bad_dir / "new.py").write_text(textwrap.dedent(CORPUS["R1"][1]))
+    full = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert len(full["findings"]) == 2
+    changed = lint_paths(
+        [str(tmp_path)], root=str(tmp_path), changed_ref="HEAD"
+    )
+    assert [f["file"] for f in changed["findings"]] == ["_private/new.py"]
+    assert changed["changed"]["ref"] == "HEAD"
+
+
+def test_sarif_output_and_exit_code(tmp_path):
+    """--sarif is the pre-commit/CI entry point: SARIF 2.1.0 on stdout,
+    rc 1 when there are findings."""
+    bad_dir = tmp_path / "_private"
+    bad_dir.mkdir()
+    (bad_dir / "daemon.py").write_text(textwrap.dedent(CORPUS["R1"][1]))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "--sarif", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "raylint"
+    assert any(r["ruleId"] == "R1" for r in run["results"])
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"R7", "R8", "R9", "S1"} <= rule_ids
 
 
 def test_repo_is_raylint_clean():

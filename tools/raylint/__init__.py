@@ -1,23 +1,36 @@
-"""raylint — repo-native static invariant checker for the async control
-plane (stdlib ``ast`` only, no dependencies).
+"""raylint 2.0 — repo-native static invariant checker for the async
+control plane (stdlib ``ast`` only, no dependencies).
 
-PRs 1–2 introduced invariants that nothing enforced mechanically:
-control-plane mutations ride ``rpc.run_idempotent`` (effectively-once),
-every wire send path passes the chaos hook, chaos-replayed code consumes
-no unseeded time/randomness, writable shm views never escape
-``serialization._pinned_buffer``, and event-loop tasks never swallow
-cancellation.  raylint walks the AST and enforces them as tier-1 tests
-(``tests/test_raylint.py``) and a bench-gate metric (``bench.py``).
+PRs 1–2 introduced invariants that nothing enforced mechanically;
+PR 3 made the single-file, direct-call shapes of them lintable (R1–R6).
+PR 14 rebuilt the analyzer as **two passes**: pass 1 walks every module
+under the linted roots and builds a project-wide symbol table + call
+graph (``tools/raylint/graph.py`` — module-qualified functions and
+methods, best-effort ``self.``-method resolution, decorator/nested-def
+handling); pass 2 runs flow-aware rules over it, so call chains that
+cross functions and modules are visible (a sync helper that calls
+``time.sleep`` two hops below an async handler, an ``await`` under a
+held lock that resolves into the chaos-faulted wire layer, an
+``except`` that re-raises without ``from``).  Findings are enforced as
+tier-1 tests (``tests/test_raylint.py``) and a bench-gate metric
+(``bench.py``).
 
 Usage::
 
-    python -m tools.raylint ray_tpu tests          # text report, rc 1 on findings
-    python -m tools.raylint --json ray_tpu tests   # machine-readable
+    python -m tools.raylint ray_tpu tests tools    # text report, rc 1 on findings
+    python -m tools.raylint --json ray_tpu         # machine-readable report
+    python -m tools.raylint --sarif ray_tpu        # SARIF 2.1.0 (CI annotations);
+                                                   # rc 1 on findings -> pre-commit/CI entry point
+    python -m tools.raylint --changed HEAD ray_tpu # only files touched vs a git ref
+                                                   # (the call graph still spans the whole tree)
 
 Suppress a deliberate finding on its line (or the line above, or the
 enclosing ``def`` line) with a reason::
 
     fut.result()  # raylint: disable=R1 — future is done() — non-blocking
+
+A suppression that silences nothing is itself a finding (S1
+unused-suppression), so stale disables cannot accumulate silently.
 
 Rules (DESIGN.md "Enforced invariants" maps each to the PR that
 introduced the invariant):
@@ -28,11 +41,18 @@ R3 send-bypasses-chaos     wire sends in rpc.py/conduit_rpc.py/raylet.py off the
 R4 unseeded-randomness     unseeded random/time in replay-deterministic code
 R5 writable-view-escape    Store.get(writable=True) outside the pin path
 R6 swallowed-cancellation  bare except / swallowed CancelledError in async code
+R7 transitive-blocking     sync helper chains under async/_private defs that reach blocking calls (call graph)
+R8 lock-across-await       await under a held lock resolving into the chaos-faulted wire layer (call graph)
+R9 typed-error-chain       cause-dropping ``raise`` in except / untyped TimeoutError in control-plane modules
+S1 unused-suppression      a ``# raylint: disable`` that silences nothing
 """
 
 from tools.raylint.core import (  # noqa: F401
     RULES,
     Finding,
+    changed_files,
+    format_sarif,
     lint_paths,
     lint_source,
 )
+from tools.raylint.graph import ProjectIndex  # noqa: F401

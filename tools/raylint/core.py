@@ -1,15 +1,28 @@
-"""raylint engine: file walking, suppressions, reporting.
+"""raylint engine: file walking, the two-pass drive, suppressions,
+reporting (text / JSON / SARIF) and the ``--changed`` mode.
 
-The rule checkers live in :mod:`tools.raylint.rules`; this module owns
-everything rule-independent — parsing, the ``# raylint: disable=<rule>``
-suppression protocol, and the text/JSON reports.
+The rule checkers live in :mod:`tools.raylint.rules`; the pass-1
+project index (symbol table + call graph) lives in
+:mod:`tools.raylint.graph`.  This module owns everything
+rule-independent — parsing, the two-pass orchestration (**pass 1**
+parses every file and builds one ``ProjectIndex`` over the whole
+input set, **pass 2** runs the rules per file with the index in hand,
+so the flow rules R7/R8 see cross-module call chains), the
+``# raylint: disable=<rule>`` suppression protocol, and the reports.
 
 Suppression protocol: a finding is silenced when a ``# raylint:
-disable=R3`` (rule id, rule name, or ``all``; comma-separated for
-several) comment sits on the finding's line, the line directly above
-it, or the ``def`` line of the enclosing function. Suppressions are
-counted and surfaced in the JSON report so a creeping pile of disables
-is itself visible.
+disable=R3 — reason`` (rule id, rule name, or ``all``; comma-separated
+for several) comment sits on the finding's line, the line directly
+above it, or the ``def`` line of the enclosing function.  Suppressions
+are counted in the report, and a suppression that silences *nothing*
+is itself a finding (rule **S1 unused-suppression**) — so a creeping
+pile of stale disables fails the gate instead of hiding future
+regressions.
+
+``--changed <git-ref>`` lints only files touched vs the ref: the
+project index is still built over the **whole** input set (the flow
+rules need the full graph — a changed helper can break an unchanged
+handler), but findings are filtered to the changed files.
 """
 
 from __future__ import annotations
@@ -18,7 +31,9 @@ import ast
 import json
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.graph import ProjectIndex
 
 #: rule id -> short name. Stable: tests and bench assert on these.
 RULES = {
@@ -28,6 +43,10 @@ RULES = {
     "R4": "unseeded-randomness",
     "R5": "writable-view-escape",
     "R6": "swallowed-cancellation",
+    "R7": "transitive-blocking",
+    "R8": "lock-across-await",
+    "R9": "typed-error-chain",
+    "S1": "unused-suppression",
 }
 _NAME_TO_ID = {name: rid for rid, name in RULES.items()}
 
@@ -67,12 +86,39 @@ class Finding:
         return f"<Finding {self.file}:{self.line} {self.rule}>"
 
 
+def _comment_lines(source: str) -> Optional[Set[int]]:
+    """1-based line numbers holding a real ``#`` comment token, or None
+    if tokenization fails (caller falls back to the raw line scan).
+    Keeps disable text inside string literals (test fixtures,
+    docstring usage examples) from registering as suppressions."""
+    import io
+    import tokenize
+
+    lines: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                lines.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return lines
+
+
 def _parse_suppressions(source: str) -> Dict[int, set]:
     """Map 1-based line number -> set of suppressed rule ids ('*' = all)."""
     out: Dict[int, set] = {}
+    comment_lines: Optional[Set[int]] = None
+    scanned = False
     for i, text in enumerate(source.splitlines(), start=1):
         m = _DISABLE_RE.search(text)
         if not m:
+            continue
+        # tokenize lazily, only for files that contain disable text at
+        # all — it is pure-Python slow, and most files have none
+        if not scanned:
+            comment_lines = _comment_lines(source)
+            scanned = True
+        if comment_lines is not None and i not in comment_lines:
             continue
         rules = set()
         for tok in m.group(1).split(","):
@@ -90,42 +136,104 @@ def _parse_suppressions(source: str) -> Dict[int, set]:
     return out
 
 
-def _suppressed(finding: Finding, supp: Dict[int, set]) -> bool:
-    anchors = [finding.line, finding.line - 1]
-    if finding.func_line is not None:
-        anchors.append(finding.func_line)
-    for ln in anchors:
-        rules = supp.get(ln)
-        if rules and ("*" in rules or finding.rule in rules):
-            return True
-    return False
+def _filter_suppressed(raw: List[Finding], supp: Dict[int, set]
+                       ) -> Tuple[List[Finding], Set[int]]:
+    """Drop suppressed findings; return (visible, used disable lines)."""
+    visible: List[Finding] = []
+    used: Set[int] = set()
+    for f in raw:
+        anchors = [f.line, f.line - 1]
+        if f.func_line is not None:
+            anchors.append(f.func_line)
+        hit = [ln for ln in anchors
+               if (rules := supp.get(ln))
+               and ("*" in rules or f.rule in rules)]
+        if hit:
+            used.update(hit)
+        else:
+            visible.append(f)
+    return visible, used
+
+
+def _unused_suppression_findings(path: str, supp: Dict[int, set],
+                                 used: Set[int],
+                                 enabled: Set[str]) -> List[Finding]:
+    """S1: a disable comment that silenced nothing.  Only judged when
+    every rule the comment names is enabled in this run (an R7 disable
+    is not 'unused' just because you ran ``--rules R1``)."""
+    out: List[Finding] = []
+    if "S1" not in enabled:
+        return out
+    for ln in sorted(supp):
+        if ln in used:
+            continue
+        rules = supp[ln]
+        if not ("*" in rules or rules <= enabled):
+            continue
+        spec = "all" if "*" in rules else ",".join(sorted(rules))
+        out.append(Finding(
+            path, ln, 0, "S1",
+            f"unused suppression (disable={spec}): it silences no "
+            f"finding — remove it (a stale disable hides the next real "
+            f"regression on this line)"))
+    return out
+
+
+def _lint_tree(tree: ast.AST, source: str, path: str,
+               enabled: Set[str],
+               index: Optional[ProjectIndex]
+               ) -> Tuple[List[Finding], int]:
+    """Run pass 2 over one parsed file: rules, suppression filtering,
+    unused-suppression findings.  Returns (visible findings incl. S1,
+    suppressed count)."""
+    from tools.raylint import rules as rule_mod
+
+    raw = rule_mod.check_tree(tree, path, enabled, index=index)
+    supp = _parse_suppressions(source)
+    visible, used = _filter_suppressed(raw, supp)
+    s1_raw = _unused_suppression_findings(path, supp, used, enabled)
+    # an S1 finding is suppressible like any other (disable=S1 on the
+    # line); a disable it uses counts as used, so no fixpoint needed
+    s1_visible, _ = _filter_suppressed(s1_raw, supp)
+    visible.extend(s1_visible)
+    visible.sort(key=lambda f: (f.line, f.col, f.rule))
+    suppressed = (len(raw) - (len(visible) - len(s1_visible))) + (
+        len(s1_raw) - len(s1_visible))
+    return visible, suppressed
 
 
 def lint_source(source: str, path: str,
-                rules: Optional[Iterable[str]] = None
+                rules: Optional[Iterable[str]] = None,
+                index: Optional[ProjectIndex] = None
                 ) -> Tuple[List[Finding], int]:
     """Lint one file's source. Returns (visible findings, suppressed
     count). ``path`` drives rule scoping (``_private/`` membership,
-    basename) — pass a repo-relative path."""
-    from tools.raylint import rules as rule_mod
-
+    basename) — pass a repo-relative path.  Without an ``index`` a
+    single-file project index is built, so the flow rules R7/R8 still
+    see call chains *within* the file."""
     tree = ast.parse(source, filename=path)
+    if index is None:
+        index = ProjectIndex.build([(path, tree)])
     enabled = set(rules) if rules else set(RULES)
-    raw = rule_mod.check_tree(tree, path, enabled)
-    supp = _parse_suppressions(source)
-    visible = [f for f in raw if not _suppressed(f, supp)]
-    return visible, len(raw) - len(visible)
+    return _lint_tree(tree, source, path, enabled, index)
 
 
 _SKIP_DIRS = {"__pycache__", "_native", ".git", ".pytest_cache", "node_modules"}
 
 
 def iter_py_files(paths: Iterable[str], root: str = ".") -> List[str]:
-    out = []
+    out: List[str] = []
+    seen: Set[str] = set()
+
+    def add(p: str):
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+
     for p in paths:
         full = p if os.path.isabs(p) else os.path.join(root, p)
         if os.path.isfile(full):
-            out.append(full)
+            add(full)
             continue
         for dirpath, dirnames, filenames in os.walk(full):
             dirnames[:] = sorted(
@@ -133,45 +241,96 @@ def iter_py_files(paths: Iterable[str], root: str = ".") -> List[str]:
             )
             for f in sorted(filenames):
                 if f.endswith(".py"):
-                    out.append(os.path.join(dirpath, f))
+                    add(os.path.join(dirpath, f))
     return out
 
 
-def lint_paths(paths: Iterable[str], root: str = ".",
-               rules: Optional[Iterable[str]] = None) -> dict:
-    """Lint every .py file under ``paths``. Returns the report dict used
-    by both the CLI and the bench gate:
+def changed_files(ref: str, root: str = ".") -> Set[str]:
+    """Repo-relative posix paths of .py files touched vs ``ref``
+    (committed diff + working tree + untracked)."""
+    import subprocess
 
-    ``{"version": 1, "files_checked": n, "findings": [...],
-       "suppressed": n, "counts": {rule_id: n}, "errors": [...]}``
+    names: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                              text=True, timeout=60)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        names.update(proc.stdout.split())
+    return {n for n in names if n.endswith(".py")}
+
+
+def lint_paths(paths: Iterable[str], root: str = ".",
+               rules: Optional[Iterable[str]] = None,
+               changed_ref: Optional[str] = None) -> dict:
+    """Lint every .py file under ``paths`` (two passes: project index,
+    then rules).  Returns the report dict used by the CLI, the bench
+    gate and the tier-1 lint test:
+
+    ``{"version": 2, "files_checked": n, "findings": [...],
+       "suppressed": n, "unused_suppressions": n,
+       "counts": {rule_id: n}, "errors": [...]}``
+
+    With ``changed_ref`` the index still spans the whole input set but
+    findings/errors are filtered to files touched vs the git ref, and a
+    ``"changed"`` key records the ref + file count.
     """
-    findings: List[Finding] = []
-    errors: List[dict] = []
-    suppressed = 0
+    enabled = set(rules) if rules else set(RULES)
     files = iter_py_files(paths, root=root)
+
+    # ---- pass 1: parse everything, build one project-wide index
+    parsed: List[Tuple[str, str, ast.AST]] = []  # (rel, source, tree)
+    errors: List[dict] = []
     for full in files:
         rel = os.path.relpath(full, root)
         try:
             with open(full, "r", encoding="utf-8", errors="replace") as f:
                 source = f.read()
-            vis, supp = lint_source(source, rel, rules=rules)
+            tree = ast.parse(source, filename=rel)
         except SyntaxError as e:
             errors.append({"file": rel, "line": e.lineno or 0,
                            "error": f"parse error: {e.msg}"})
             continue
+        parsed.append((rel, source, tree))
+    index = ProjectIndex.build([(rel, tree) for rel, _, tree in parsed])
+
+    # ---- pass 2: flow-aware rules per file, suppression accounting
+    findings: List[Finding] = []
+    suppressed = 0
+    for rel, source, tree in parsed:
+        vis, supp = _lint_tree(tree, source, rel, enabled, index)
         findings.extend(vis)
         suppressed += supp
+
+    changed_detail = None
+    if changed_ref is not None:
+        changed = changed_files(changed_ref, root=root)
+
+        def _posix(p: str) -> str:
+            return p.replace(os.sep, "/")
+
+        findings = [f for f in findings if _posix(f.file) in changed]
+        errors = [e for e in errors if _posix(e["file"]) in changed]
+        changed_detail = {"ref": changed_ref, "files": len(changed)}
+
     counts: Dict[str, int] = {}
     for f in findings:
         counts[f.rule] = counts.get(f.rule, 0) + 1
-    return {
-        "version": 1,
+    report = {
+        "version": 2,
         "files_checked": len(files),
         "findings": [f.as_dict() for f in findings],
         "suppressed": suppressed,
+        "unused_suppressions": counts.get("S1", 0),
         "counts": counts,
         "errors": errors,
     }
+    if changed_detail is not None:
+        report["changed"] = changed_detail
+    return report
 
 
 def format_text(report: dict) -> str:
@@ -192,14 +351,90 @@ def format_text(report: dict) -> str:
     return "\n".join(lines)
 
 
+def format_sarif(report: dict) -> str:
+    """SARIF 2.1.0 — one run, one result per finding/parse error, for
+    CI annotation surfaces and editor problem matchers."""
+    def result(rule_id: str, message: str, path: str, line: int,
+               col: int) -> dict:
+        return {
+            "ruleId": rule_id,
+            "level": "error",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": path.replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": max(1, line),
+                        "startColumn": col + 1,
+                    },
+                },
+            }],
+        }
+
+    results = [
+        result(f["rule"], f"{f['name']}: {f['message']}", f["file"],
+               f["line"], f["col"])
+        for f in report["findings"]
+    ]
+    results.extend(
+        result("E0", e["error"], e["file"], e["line"], 0)
+        for e in report["errors"]
+    )
+    sarif = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "raylint",
+                    "version": "2.0",
+                    "informationUri": (
+                        "DESIGN.md#enforced-invariants-raylint"
+                    ),
+                    "rules": [
+                        {
+                            "id": rid,
+                            "name": name,
+                            "shortDescription": {"text": name},
+                        }
+                        for rid, name in sorted(RULES.items())
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(sarif, indent=2)
+
+
 def main(argv: List[str]) -> int:
     as_json = False
+    as_sarif = False
     rules: Optional[List[str]] = None
+    changed_ref: Optional[str] = None
     paths: List[str] = []
     it = iter(argv)
     for a in it:
         if a == "--json":
             as_json = True
+        elif a == "--sarif":
+            as_sarif = True
+        elif a.startswith("--changed"):
+            if a.startswith("--changed="):
+                changed_ref = a.split("=", 1)[1]
+            else:
+                try:
+                    changed_ref = next(it)
+                except StopIteration:
+                    print("raylint: --changed needs a git ref "
+                          "(e.g. --changed HEAD)", flush=True)
+                    return 2
+            if not changed_ref:
+                print("raylint: --changed needs a git ref", flush=True)
+                return 2
         elif a == "--rules":
             try:
                 rules = [
@@ -220,11 +455,18 @@ def main(argv: List[str]) -> int:
         else:
             paths.append(a)
     if not paths:
-        print("usage: python -m tools.raylint [--json] [--rules R1,R2] "
-              "<path> [<path> ...]", flush=True)
+        print("usage: python -m tools.raylint [--json|--sarif] "
+              "[--rules R1,R7] [--changed <git-ref>] <path> [<path> ...]",
+              flush=True)
         return 2
-    report = lint_paths(paths, rules=rules)
-    if as_json:
+    try:
+        report = lint_paths(paths, rules=rules, changed_ref=changed_ref)
+    except RuntimeError as e:
+        print(f"raylint: {e}", flush=True)
+        return 2
+    if as_sarif:
+        print(format_sarif(report))
+    elif as_json:
         print(json.dumps(report, indent=2))
     else:
         print(format_text(report))

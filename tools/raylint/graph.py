@@ -1,0 +1,415 @@
+"""raylint pass 1: project-wide symbol table + call graph.
+
+PR 3's raylint saw one file at a time, so it could only flag a blocking
+call *directly* inside an ``async def``.  The defect classes that
+actually hurt in a soak — a sync helper that calls ``time.sleep`` two
+hops below an async handler, a lock held across an ``await`` that
+resolves into the chaos-faulted wire layer — live in the *edges between*
+functions.  This module builds those edges once per run:
+
+* **Symbol table** — every module under the linted roots is indexed by
+  its repo-relative path; per module we record import aliases
+  (``import x as y``), from-imports (``from m import f as g``, relative
+  levels resolved against the module's package), top-level functions,
+  classes with their methods (nested classes dotted), and nested defs
+  (registered under their enclosing function).
+* **Call graph** — every call site in every function body records the
+  raw dotted target, the alias-resolved external name, and (link phase)
+  a best-effort resolution to a project function: ``self.m()`` /
+  ``cls.m()`` to a method of the same class, bare names through nested
+  defs → module functions → from-imports, dotted names through
+  aliases/from-imports with longest-prefix module matching
+  (``rpc.Conn.call`` resolves if ``rpc`` maps to a project module).
+  Decorated defs index like plain defs (the name binding is the same);
+  calls inside nested defs belong to the nested function, not its
+  parent.
+* **Taints** (memoized, O(nodes + edges), cycle-safe):
+  ``sync_block_chain(q)`` — the call chain (if any) by which a sync
+  project function transitively reaches a loop-blocking call
+  (``BLOCKING_CALLS``); propagation runs through sync functions only,
+  because an awaited ``async def`` suspends rather than blocks.
+  ``wire_chain(q)`` — the call chain by which a function reaches the
+  chaos-faulted wire layer (``WIRE_BASENAMES``), through sync or async
+  callees alike.
+
+Everything here is best-effort by design: an unresolved call is simply
+not an edge (never a finding), so the graph adds recall to the
+flow-aware rules R7/R8 without inventing false positives of its own.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: Calls that block the event loop outright.  R1 flags them directly
+#: inside async/loop-inline defs; R7 flags sync helpers that reach them
+#: transitively from such a def.
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "os.system",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    # a per-batch fsync is ~ms of synchronous disk wait — run it in an
+    # executor, never inline on the loop
+    "os.fsync",
+    "os.fdatasync",
+})
+
+#: The chaos-faulted wire layer (module basenames): every send/recv in
+#: these modules consults the chaos plane, so an await that resolves
+#: into them can be parked indefinitely by an injected partition.
+WIRE_BASENAMES = frozenset({"rpc.py", "conduit_rpc.py"})
+
+#: Docstring markers by which a SYNC def declares it executes on the
+#: event loop (call_soon / call_later callbacks) and opts into the
+#: async-side rules (R1 blocking checks, R7 roots).
+LOOP_MARKERS = ("runs on the event loop", "loop-inline")
+
+
+def dotted_name(node: ast.expr) -> str:
+    """Best-effort dotted name of an expression ('self.writer.write')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def walk_body(fn: ast.AST):
+    """Yield nodes of a function body without descending into nested
+    function/lambda definitions (their bodies are their own context)
+    or the def's own decorator/default expressions."""
+    stack: List[ast.AST] = []
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        stack.extend(fn.body)
+    else:
+        stack.extend(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallSite:
+    """One call expression inside a function body."""
+
+    __slots__ = ("name", "extern", "lineno", "col", "awaited", "node_id",
+                 "target")
+
+    def __init__(self, name: str, extern: str, lineno: int, col: int,
+                 awaited: bool, node_id: int):
+        self.name = name          # raw dotted target ('self.helper')
+        self.extern = extern      # alias/from-import-resolved dotted name
+        self.lineno = lineno
+        self.col = col
+        self.awaited = awaited    # is this call the value of an Await?
+        self.node_id = node_id    # id() of the ast.Call node
+        self.target: Optional[str] = None  # qname of a project function
+
+
+class FunctionInfo:
+    """One function/method/nested def in the project."""
+
+    __slots__ = ("qname", "path", "qualname", "name", "lineno", "node",
+                 "is_async", "loop_marked", "cls", "parent", "nested",
+                 "calls", "direct_blocking")
+
+    def __init__(self, qname: str, path: str, qualname: str,
+                 node: ast.AST, cls: Optional[str],
+                 parent: Optional[str]):
+        self.qname = qname
+        self.path = path
+        self.qualname = qualname
+        self.name = node.name
+        self.lineno = node.lineno
+        self.node = node
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        doc = (ast.get_docstring(node) or "").lower()
+        self.loop_marked = any(m in doc for m in LOOP_MARKERS)
+        self.cls = cls            # enclosing class qualname, if a method
+        self.parent = parent      # qname of the enclosing function
+        self.nested: Dict[str, str] = {}   # nested def name -> qname
+        self.calls: List[CallSite] = []
+        #: (extern name, lineno) of directly-blocking calls in the body
+        self.direct_blocking: List[Tuple[str, int]] = []
+
+    @property
+    def display(self) -> str:
+        return f"{os.path.basename(self.path)}:{self.qualname}"
+
+
+class ModuleInfo:
+    __slots__ = ("path", "modname", "is_pkg", "aliases", "symbols",
+                 "classes", "top")
+
+    def __init__(self, path: str, modname: str, is_pkg: bool):
+        self.path = path
+        self.modname = modname
+        self.is_pkg = is_pkg
+        self.aliases: Dict[str, str] = {}   # local name -> module dotted
+        self.symbols: Dict[str, str] = {}   # local name -> module.attr
+        self.classes: Dict[str, Dict[str, str]] = {}  # cls -> meth -> qname
+        self.top: Dict[str, str] = {}       # top-level func -> qname
+
+
+def _module_name(path: str) -> Tuple[str, bool]:
+    p = path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    is_pkg = False
+    if p.endswith("/__init__") or p == "__init__":
+        p = p[: -len("__init__")].rstrip("/")
+        is_pkg = True
+    return p.strip("/").replace("/", "."), is_pkg
+
+
+class ProjectIndex:
+    """Pass-1 output: symbol table + linked call graph + taint caches."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_path: Dict[str, List[str]] = {}
+        self._modname_to_path: Dict[str, str] = {}
+        self._block_chain: Dict[str, Optional[List[str]]] = {}
+        self._wire_chain: Dict[str, Optional[List[str]]] = {}
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(cls, files: List[Tuple[str, ast.AST]]) -> "ProjectIndex":
+        idx = cls()
+        for path, tree in files:
+            idx._index_module(path, tree)
+        idx._link()
+        return idx
+
+    def _index_module(self, path: str, tree: ast.AST) -> None:
+        modname, is_pkg = _module_name(path)
+        m = ModuleInfo(path, modname, is_pkg)
+        self.modules[path] = m
+        self._by_path[path] = []
+        self._modname_to_path[modname] = path
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    m.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    parts = m.modname.split(".") if m.modname else []
+                    # level 1 anchors at the module's own package (the
+                    # module itself when it IS a package __init__)
+                    drop = node.level - (1 if m.is_pkg else 0)
+                    anchor = parts[: len(parts) - drop] if drop > 0 else parts
+                    base = ".".join(anchor + ([node.module] if node.module
+                                              else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    m.symbols[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+
+        def visit(node: ast.AST, cls_name: Optional[str],
+                  parent: Optional[FunctionInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                # def/class statements never live inside an expression
+                # subtree (lambdas/comprehensions cannot contain them),
+                # so skip descending into expressions entirely
+                if isinstance(child, ast.expr):
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    cq = f"{cls_name}.{child.name}" if cls_name else child.name
+                    m.classes.setdefault(cq, {})
+                    visit(child, cq, None)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    if parent is not None:
+                        qualname = f"{parent.qualname}.{child.name}"
+                    elif cls_name:
+                        qualname = f"{cls_name}.{child.name}"
+                    else:
+                        qualname = child.name
+                    qname = f"{path}::{qualname}"
+                    f = FunctionInfo(qname, path, qualname, child,
+                                     cls_name,
+                                     parent.qname if parent else None)
+                    self.functions[qname] = f
+                    self._by_path[path].append(qname)
+                    if parent is not None:
+                        parent.nested[child.name] = qname
+                    elif cls_name:
+                        m.classes[cls_name][child.name] = qname
+                    else:
+                        m.top[child.name] = qname
+                    self._collect_calls(f, m)
+                    visit(child, cls_name, f)
+                else:
+                    visit(child, cls_name, parent)
+
+        visit(tree, None, None)
+
+    def _collect_calls(self, f: FunctionInfo, m: ModuleInfo) -> None:
+        # single pass: walk_body yields a parent before its children, so
+        # an Await is always seen before the Call it wraps
+        awaited_ids = set()
+        for node in walk_body(f.node):
+            if isinstance(node, ast.Await):
+                if isinstance(node.value, ast.Call):
+                    awaited_ids.add(id(node.value))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            extern = self._extern_name(name, m)
+            site = CallSite(name, extern, node.lineno, node.col_offset,
+                            id(node) in awaited_ids, id(node))
+            f.calls.append(site)
+            if extern in BLOCKING_CALLS:
+                f.direct_blocking.append((extern, node.lineno))
+
+    @staticmethod
+    def _extern_name(name: str, m: ModuleInfo) -> str:
+        head, _, rest = name.partition(".")
+        if head in m.aliases:
+            real = m.aliases[head]
+            return real + ("." + rest if rest else "")
+        if head in m.symbols:
+            return m.symbols[head] + ("." + rest if rest else "")
+        return name
+
+    # ------------------------------------------------------------ link
+
+    def _link(self) -> None:
+        for f in self.functions.values():
+            m = self.modules[f.path]
+            for c in f.calls:
+                c.target = self._resolve_call(f, m, c.name)
+
+    def _resolve_call(self, f: FunctionInfo, m: ModuleInfo,
+                      name: str) -> Optional[str]:
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and f.cls:
+            if len(parts) == 2:
+                return m.classes.get(f.cls, {}).get(parts[1])
+            return None
+        if len(parts) == 1:
+            n = parts[0]
+            g: Optional[FunctionInfo] = f
+            while g is not None:
+                if n in g.nested:
+                    return g.nested[n]
+                g = self.functions.get(g.parent) if g.parent else None
+            if n in m.top:
+                return m.top[n]
+            if n in m.symbols:
+                return self._resolve_global(m.symbols[n])
+            return None
+        head = parts[0]
+        if head in m.classes and len(parts) == 2:
+            return m.classes[head].get(parts[1])
+        if head in m.aliases:
+            return self._resolve_global(
+                m.aliases[head] + "." + ".".join(parts[1:]))
+        if head in m.symbols:
+            return self._resolve_global(
+                m.symbols[head] + "." + ".".join(parts[1:]))
+        return None
+
+    def _resolve_global(self, dotted: str) -> Optional[str]:
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:i])
+            path = self._modname_to_path.get(modname)
+            if path is None:
+                continue
+            m = self.modules[path]
+            rest = parts[i:]
+            if len(rest) == 1:
+                return m.top.get(rest[0])
+            if len(rest) == 2:
+                return m.classes.get(rest[0], {}).get(rest[1])
+            return None
+        return None
+
+    # ------------------------------------------------------------ query
+
+    def functions_in(self, path: str) -> List[FunctionInfo]:
+        return [self.functions[q] for q in self._by_path.get(path, ())]
+
+    def sync_block_chain(self, qname: str) -> Optional[List[str]]:
+        """If the SYNC function ``qname`` transitively reaches a
+        loop-blocking call, return the chain of display names ending in
+        the blocking call; else None.  Async functions never propagate
+        (an awaited coroutine suspends, it does not block)."""
+        return self._chain(qname, self._block_chain, set(),
+                           self._block_step)
+
+    def wire_chain(self, qname: str) -> Optional[List[str]]:
+        """If ``qname`` transitively reaches the chaos-faulted wire layer
+        (is defined there, or calls — sync or async — something that
+        is), return the chain of display names; else None."""
+        return self._chain(qname, self._wire_chain, set(),
+                           self._wire_step)
+
+    def _chain(self, q: str, cache: Dict[str, Optional[List[str]]],
+               stack: set, step) -> Optional[List[str]]:
+        if q in cache:
+            return cache[q]
+        if q in stack:
+            return None  # cycle: no chain through here
+        f = self.functions.get(q)
+        if f is None:
+            return None
+        stack.add(q)
+        res = step(f, cache, stack)
+        stack.discard(q)
+        cache[q] = res
+        return res
+
+    def _block_step(self, f: FunctionInfo, cache, stack):
+        if f.is_async:
+            return None
+        if f.direct_blocking:
+            return [f.display, f.direct_blocking[0][0] + "()"]
+        for c in f.calls:
+            if c.target is None:
+                continue
+            g = self.functions.get(c.target)
+            if g is None or g.is_async:
+                continue
+            sub = self._chain(c.target, cache, stack, self._block_step)
+            if sub:
+                return [f.display] + sub
+        return None
+
+    def _wire_step(self, f: FunctionInfo, cache, stack):
+        if os.path.basename(f.path) in WIRE_BASENAMES:
+            return [f.display]
+        for c in f.calls:
+            if c.target is None:
+                continue
+            sub = self._chain(c.target, cache, stack, self._wire_step)
+            if sub:
+                return [f.display] + sub
+        return None

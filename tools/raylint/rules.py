@@ -1,4 +1,4 @@
-"""raylint rule checkers R1–R6.
+"""raylint rule checkers R1–R9.
 
 Every rule is grounded in an invariant this codebase already relies on
 (see DESIGN.md "Enforced invariants" for the PR that introduced each):
@@ -29,6 +29,29 @@ R5 writable-view-escape    ``Store.get(writable=True)`` exists solely to
 R6 swallowed-cancellation  ``asyncio.CancelledError`` must propagate out
                            of event-loop tasks or daemon loops never
                            shut down (bare ``except:`` swallows it).
+R7 transitive-blocking     (PR 14, flow-aware) R1's "blocks the event
+                           loop" taint propagated through the project
+                           call graph: a sync helper that transitively
+                           hits ``time.sleep``/``os.fsync``/sync socket
+                           ops, called from an ``async def`` (or
+                           loop-inline-marked sync def), stalls the loop
+                           exactly like a direct call — the finding
+                           names the full call chain.
+R8 lock-across-await       (PR 14, flow-aware) an ``await`` inside a
+                           held ``threading.Lock``/``asyncio.Lock``
+                           whose awaited call resolves (via the call
+                           graph) into the chaos-faulted wire layer
+                           (rpc.py / conduit_rpc.py): an injected
+                           partition parks the coroutine with the lock
+                           held — the shape that deadlocks mid-soak.
+R9 typed-error-chain       (PR 14) a mid-soak failure must surface as
+                           ONE attributable typed error chain, never a
+                           blank TimeoutError: ``raise X(...)`` inside
+                           an ``except`` without ``from`` severs the
+                           causal chain, and a bare ``TimeoutError`` /
+                           ``asyncio.TimeoutError`` raise escapes the
+                           repo's typed-exception surface
+                           (``ray_tpu/exceptions.py``).
 
 Scoping: R1 applies to files under a ``_private/`` directory; R3 and the
 module prong of R4 apply to the wire/control modules by basename (R4
@@ -37,7 +60,12 @@ whose re-placement/rendezvous jitter is chaos-replayed); the
 docstring prong of R4 applies anywhere a function's docstring declares
 determinism ("deterministic", "replayable", "byte-identical",
 "pure function", "chaos-replay" — the repo convention these checkers
-enforce); R2/R5/R6 apply everywhere.
+enforce); R2/R5/R6 apply everywhere.  The PR-14 flow rules: R7 roots
+are ``async def`` / loop-inline-marked sync defs under ``_private/``
+(the taint itself follows the call graph into any module); R8 applies
+everywhere an await can hold a lock (the wire-layer resolution does the
+scoping); R9 applies to the control-plane packages — files under
+``_private/`` or ``serve/``.
 """
 
 from __future__ import annotations
@@ -47,35 +75,25 @@ import os
 from typing import Dict, List, Optional, Set
 
 from tools.raylint.core import Finding
+from tools.raylint.graph import (
+    BLOCKING_CALLS,
+    LOOP_MARKERS,
+    ProjectIndex,
+    walk_body,
+)
 
 # ---------------------------------------------------------------- helpers
 
-#: R1: calls that block the event loop outright.
-_R1_BLOCKING = {
-    "time.sleep",
-    "os.system",
-    "subprocess.run",
-    "subprocess.call",
-    "subprocess.check_call",
-    "subprocess.check_output",
-    "subprocess.getoutput",
-    "subprocess.getstatusoutput",
-    "socket.create_connection",
-    "socket.getaddrinfo",
-    "socket.gethostbyname",
-    # r11 (GCS journal group commit): a per-batch fsync is ~ms of
-    # synchronous disk wait — run it in an executor, never inline on
-    # the loop (the batched page-cache write+flush is fine inline)
-    "os.fsync",
-    "os.fdatasync",
-}
+#: R1: calls that block the event loop outright (shared with R7's
+#: transitive taint — the canonical set lives in graph.py).
+_R1_BLOCKING = BLOCKING_CALLS
 #: R1: blocking file ops (use asyncio.to_thread / run_in_executor).
 _R1_FILE = {"open", "os.listdir", "os.stat", "os.path.getsize"}
 #: R1 sync-def prong (r11): SYNC functions that by contract execute on
 #: the event loop (call_soon/call_later callbacks — the GCS journal
 #: group-commit flush is the exemplar) declare it in their docstring
 #: and get the same blocking/file checks as async defs.
-_R1_LOOP_MARKERS = ("runs on the event loop", "loop-inline")
+_R1_LOOP_MARKERS = LOOP_MARKERS
 
 #: R3 scope + R4 module-prong scope (wire/control modules by basename).
 #: raylet.py joined R3 in r9: the broadcast-tree fan-out serves chunk
@@ -224,16 +242,17 @@ def _check_r1(fn, path: str, aliases,
 def _check_r2(tree: ast.AST, path: str, func_of,
               findings: List[Finding]):
     wrapped: Set[int] = set()
+    handler_calls: List[ast.Call] = []
     for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _dotted(node.func).endswith(
-            "run_idempotent"
-        ):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func).endswith("run_idempotent"):
             wrapped |= _subtree_calls(node)
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "handler"
-                and id(node) not in wrapped):
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "handler"):
+            handler_calls.append(node)
+    for node in handler_calls:
+        if id(node) not in wrapped:
             fn = func_of(node)
             findings.append(Finding(
                 path, node.lineno, node.col_offset, "R2",
@@ -257,11 +276,9 @@ def _fn_touches_chaos(fn: ast.AST) -> bool:
     return False
 
 
-def _check_r3(tree: ast.AST, path: str, func_of,
+def _check_r3(fn_nodes, path: str, func_of,
               findings: List[Finding]):
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for fn in fn_nodes:
         # compliant if the function — or any enclosing function (a
         # closure defined inside _chaos_gate IS the chaos plane's write
         # path) — consults the chaos plane
@@ -290,16 +307,14 @@ def _check_r3(tree: ast.AST, path: str, func_of,
                     func_line=fn.lineno))
 
 
-def _check_r4(tree: ast.AST, path: str, aliases,
+def _check_r4(fn_nodes, path: str, aliases,
               findings: List[Finding]):
     base = os.path.basename(path)
     segments = path.replace(os.sep, "/").split("/")
     module_scope = base in _R4_FILES or bool(
         _R4_DIRS.intersection(segments[:-1])
     )
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for fn in fn_nodes:
         doc = (ast.get_docstring(fn) or "").lower()
         marked = any(m in doc for m in _R4_DOC_MARKERS)
         if not (marked or module_scope):
@@ -388,49 +403,210 @@ def _check_r6(fn: ast.AsyncFunctionDef, path: str,
             func_line=fn.lineno))
 
 
+# ------------------------------------------------- flow rules (PR 14)
+
+#: R9: untyped timeout raises that must be wrapped in a repo-typed
+#: exception from ray_tpu/exceptions.py (GetTimeoutError subclasses
+#: TimeoutError, so wrapping never breaks an existing except clause).
+_R9_TIMEOUTS = {
+    "TimeoutError",
+    "asyncio.TimeoutError",
+    "asyncio.exceptions.TimeoutError",
+    "socket.timeout",
+}
+
+
+def _check_r7(fi, index: ProjectIndex, path: str,
+              findings: List[Finding]):
+    """Transitive-blocking: ``fi`` is an async def (or loop-inline sync
+    def) in _private/; flag call sites whose SYNC project target
+    transitively reaches a loop-blocking call.  Direct blocking calls
+    are R1's job — R7 only fires when the block is ≥ 1 project-function
+    hop away, and the finding names the whole chain."""
+    what = "async def" if fi.is_async else "loop-inline def"
+    for c in fi.calls:
+        if c.target is None:
+            continue
+        g = index.functions.get(c.target)
+        if g is None or g.is_async:
+            continue
+        chain = index.sync_block_chain(c.target)
+        if chain:
+            full = " -> ".join([fi.display] + chain)
+            findings.append(Finding(
+                path, c.lineno, c.col, "R7",
+                f"transitive blocking call inside {what} {fi.name}: "
+                f"{full} — the tail blocks the event loop "
+                f"{len(chain) - 1} hop(s) down (make the helper async, "
+                f"or run it via asyncio.to_thread / run_in_executor)",
+                func_line=fi.lineno))
+
+
+def _check_r8(fi, index: ProjectIndex, path: str,
+              findings: List[Finding]):
+    """Lock-across-await into the wire layer: an ``await`` under a held
+    threading/asyncio lock whose awaited call resolves into
+    rpc.py/conduit_rpc.py — a chaos-injected partition parks the
+    coroutine with the lock held."""
+    if not fi.is_async:
+        return
+    site_by_id = {c.node_id: c for c in fi.calls}
+    for w in walk_body(fi.node):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        ctx = " ".join(
+            _dotted(item.context_expr.func)
+            if isinstance(item.context_expr, ast.Call)
+            else _dotted(item.context_expr)
+            for item in w.items
+        )
+        if "lock" not in ctx.lower():
+            continue
+        kind = ("async with" if isinstance(w, ast.AsyncWith) else "with")
+        for stmt in w.body:
+            for x in _walk_skip_nested(stmt):
+                if not (isinstance(x, ast.Await)
+                        and isinstance(x.value, ast.Call)):
+                    continue
+                c = site_by_id.get(id(x.value))
+                if c is None or c.target is None:
+                    continue
+                chain = index.wire_chain(c.target)
+                if chain:
+                    full = " -> ".join([fi.display] + chain)
+                    findings.append(Finding(
+                        path, x.lineno, x.col_offset, "R8",
+                        f"await under held lock (`{kind} {ctx}:`) in "
+                        f"{fi.name} resolves into the chaos-faulted "
+                        f"wire layer: {full} — an injected partition "
+                        f"parks this coroutine with the lock held "
+                        f"(move the RPC outside the critical section)",
+                        func_line=fi.lineno))
+
+
+def _check_r9(tree: ast.AST, path: str, func_of,
+              findings: List[Finding]):
+    """Typed-error-chain, control-plane modules only: (a) untyped
+    TimeoutError raises; (b) ``raise X(...)`` inside an ``except``
+    handler without ``from`` (causal chain severed — the exact shape
+    that surfaces as a blank, unattributable error mid-soak)."""
+    reported: Set[int] = set()
+    handlers: List[ast.ExceptHandler] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            handlers.append(node)
+            continue
+        if not (isinstance(node, ast.Raise) and node.exc is not None):
+            continue
+        exc = node.exc
+        name = _dotted(exc.func) if isinstance(exc, ast.Call) else (
+            _dotted(exc))
+        if name in _R9_TIMEOUTS:
+            fn = func_of(node)
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "R9",
+                f"raise {name} in a control-plane module: an untyped "
+                f"timeout is unattributable mid-soak — wrap it in a "
+                f"typed exception from ray_tpu/exceptions.py "
+                f"(GetTimeoutError subclasses TimeoutError)",
+                func_line=fn.lineno if fn else None))
+            reported.add(id(node))
+    for node in handlers:
+        for stmt in node.body:
+            for x in [stmt, *_walk_skip_nested(stmt)]:
+                if not (isinstance(x, ast.Raise) and x.exc is not None
+                        and x.cause is None):
+                    continue
+                if id(x) in reported:
+                    continue
+                # `raise e` of the caught name re-raises, no chain loss
+                if (isinstance(x.exc, ast.Name) and node.name
+                        and x.exc.id == node.name):
+                    continue
+                reported.add(id(x))
+                raised = _dotted(x.exc.func) if isinstance(
+                    x.exc, ast.Call) else _dotted(x.exc)
+                fn = func_of(x)
+                findings.append(Finding(
+                    path, x.lineno, x.col_offset, "R9",
+                    f"raise {raised or '<expr>'} inside an except "
+                    f"handler without `from`: the causal chain is "
+                    f"severed, so the soak sees an unattributable "
+                    f"error — `except ... as e: raise {raised}(...) "
+                    f"from e` (or `from None` with intent)",
+                    func_line=fn.lineno if fn else None))
+
+
 # ---------------------------------------------------------------- driver
 
 
-def check_tree(tree: ast.AST, path: str,
-               enabled: Set[str]) -> List[Finding]:
+def check_tree(tree: ast.AST, path: str, enabled: Set[str],
+               index: Optional[ProjectIndex] = None) -> List[Finding]:
     findings: List[Finding] = []
     posix = path.replace(os.sep, "/")
     in_private = "_private" in posix.split("/")
     base = os.path.basename(path)
-    aliases = _import_aliases(tree)
+    mod = index.modules.get(path) if index is not None else None
+    aliases = mod.aliases if mod is not None else _import_aliases(tree)
 
-    # enclosing-function lookup (suppression anchor for def-line disables)
+    # enclosing-function lookup (suppression anchor for def-line
+    # disables).  Only the node kinds the rules ever pass to func_of are
+    # indexed — every AST node would be millions of dict inserts over a
+    # full tree.
     parent_fn: Dict[int, ast.AST] = {}
+    _INDEXED = (ast.Call, ast.Raise, ast.ExceptHandler, ast.With,
+                ast.AsyncWith, ast.FunctionDef, ast.AsyncFunctionDef)
 
-    def index(node, fn):
+    def index_parents(node, fn):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 parent_fn[id(child)] = fn
-                index(child, child)
+                index_parents(child, child)
             else:
-                parent_fn[id(child)] = fn
-                index(child, fn)
+                if isinstance(child, _INDEXED):
+                    parent_fn[id(child)] = fn
+                index_parents(child, fn)
 
-    index(tree, None)
+    index_parents(tree, None)
 
     def func_of(node) -> Optional[ast.AST]:
         return parent_fn.get(id(node))
 
+    # one function list drives every per-function rule; the pass-1
+    # index already has it (with loop-marker docstring flags), the
+    # ast.walk fallback covers index-less calls
+    fis = index.functions_in(path) if index is not None else None
+    if fis is not None:
+        fn_nodes = [fi.node for fi in fis]
+    else:
+        fn_nodes = [n for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))]
+
     if "R2" in enabled:
         _check_r2(tree, path, func_of, findings)
     if "R3" in enabled and base in _R3_FILES:
-        _check_r3(tree, path, func_of, findings)
+        _check_r3(fn_nodes, path, func_of, findings)
     if "R4" in enabled:
-        _check_r4(tree, path, aliases, findings)
+        _check_r4(fn_nodes, path, aliases, findings)
     if "R5" in enabled:
         _check_r5(tree, path, func_of, findings)
-    for node in ast.walk(tree):
+    if "R9" in enabled and (in_private or "serve" in posix.split("/")):
+        _check_r9(tree, path, func_of, findings)
+    if fis is not None:
+        for fi in fis:
+            if ("R7" in enabled and in_private
+                    and (fi.is_async or fi.loop_marked)):
+                _check_r7(fi, index, path, findings)
+            if "R8" in enabled:
+                _check_r8(fi, index, path, findings)
+    for node in fn_nodes:
         if isinstance(node, ast.AsyncFunctionDef):
             if "R1" in enabled and in_private:
                 _check_r1(node, path, aliases, findings)
             if "R6" in enabled:
                 _check_r6(node, path, findings)
-        elif isinstance(node, ast.FunctionDef):
+        else:
             # r11: SYNC defs that contractually run ON the loop
             # (call_soon / call_later callbacks) opt into R1 via a
             # docstring marker — the GCS group-commit flush path's
