@@ -495,6 +495,20 @@ def main():
                 )
             except Exception as e:
                 micro["mesh_group"] = {"error": str(e)[:160]}
+            # elastic compute plane (r15): SIGKILL one raylet under a
+            # 2-host gang and time the heal loop back to READY at the
+            # ORIGINAL shape — detect / provision (queued-resource
+            # grant + labeled raylet registration) / recover legs plus
+            # summed MTTR. Subprocess-isolated.
+            from ray_tpu._private.ray_perf import run_mesh_heal_bench
+
+            try:
+                micro["mesh_heal"] = run_mesh_heal_bench()
+                micro["mesh_heal_mttr_s"] = (
+                    micro["mesh_heal"]["mttr_s"]
+                )
+            except Exception as e:
+                micro["mesh_heal"] = {"error": str(e)[:160]}
             # data plane (r12): placement-routed, prefetched streaming
             # ingest into a RUNNING 2-host gang (step-time delta vs
             # pre-staged local batches = the "ingest never blocks the
@@ -644,6 +658,25 @@ def main():
                 violations.append({
                     "metric": "mesh_group_spinup_s",
                     "value": mgb.get("spinup_s"), "floor": "<= 60",
+                })
+        mh = micro.get("mesh_heal") or {}
+        if "error" not in mh and mh:
+            # MTTR is a latency contract (the whole point of the heal
+            # loop): detect (2s health-check ceiling) + provision
+            # (sub-second fake grant + raylet boot) + full-shape
+            # recover must land well under this generous static
+            # ceiling on any box; exactly ONE queued-resource request
+            # may be filed per failure (duplicates mean the intent
+            # journal failed)
+            if (mh.get("mttr_s") or 1e9) > 90.0:
+                violations.append({
+                    "metric": "mesh_heal_mttr_s",
+                    "value": mh.get("mttr_s"), "floor": "<= 90",
+                })
+            if (mh.get("create_calls") or 99) != 1:
+                violations.append({
+                    "metric": "mesh_heal_create_calls",
+                    "value": mh.get("create_calls"), "floor": "== 1",
                 })
         dp = micro.get("data_plane") or {}
         if "error" not in dp and dp:

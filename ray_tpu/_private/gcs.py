@@ -368,6 +368,12 @@ class GcsServer:
         # controller republishes on every state change, so a restarted
         # GCS repopulates at the gang's next transition.
         self.mesh_groups: Dict[str, Dict] = {}
+        # autoscaler intents: intent key (e.g. "heal:<gang>") -> record
+        # naming the queued-resource request in flight. JOURNALED, unlike
+        # the registries above: an intent is the only durable evidence a
+        # replacement slice was requested — lose it across a GCS SIGKILL
+        # and a healer either leaks the pending QR or files a duplicate.
+        self.autoscaler_intents: Dict[str, Dict] = {}
         self._raylet_clients: Dict[bytes, rpc.Connection] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._started = asyncio.Event()
@@ -485,6 +491,7 @@ class GcsServer:
         if snap is not None:
             self.kv = snap.get("kv", {})
             self.jobs = snap.get("jobs", {})
+            self.autoscaler_intents = dict(snap.get("intents") or {})
             for d in snap.get("actors") or []:
                 rec = ActorRecord.from_state(d)
                 self.actors[rec.actor_id] = rec
@@ -542,6 +549,12 @@ class GcsServer:
         elif op == "pg":
             prec = PgRecord.from_state(rec[1])
             self.placement_groups[prec.pg_id] = prec
+        elif op == "intent":
+            key, value = str(rec[1]), rec[2]
+            if value is None:
+                self.autoscaler_intents.pop(key, None)
+            else:
+                self.autoscaler_intents[key] = dict(value)
 
     # -- journal write side (no-ops on the memory backend) --
     def _journal(self, rec: List) -> Optional[asyncio.Future]:
@@ -725,6 +738,8 @@ class GcsServer:
             "jobs": dict(self.jobs),
             "actors": [r.to_state() for r in self.actors.values()],
             "pgs": [r.to_state() for r in self.placement_groups.values()],
+            "intents": {k: dict(v)
+                        for k, v in self.autoscaler_intents.items()},
         }
 
     def _write_snapshot(self, blob: bytes):
@@ -964,6 +979,24 @@ class GcsServer:
 
     async def rpc_mesh_group_table(self, conn, _):
         return dict(self.mesh_groups)
+
+    # -- autoscaler intents (durable provisioning WAL for healers) --
+
+    async def rpc_autoscaler_intent_put(self, conn, data):
+        key, rec = str(data[0]), dict(data[1])
+        self.autoscaler_intents[key] = rec
+        self._mark_dirty()
+        await self._journal_wait(self._journal(["intent", key, rec]))
+        return {"ok": True}
+
+    async def rpc_autoscaler_intent_del(self, conn, key):
+        existed = self.autoscaler_intents.pop(str(key), None) is not None
+        self._mark_dirty()
+        await self._journal_wait(self._journal(["intent", str(key), None]))
+        return {"ok": existed}
+
+    async def rpc_autoscaler_intent_table(self, conn, _):
+        return {k: dict(v) for k, v in self.autoscaler_intents.items()}
 
     def _resource_view(self):
         return {

@@ -141,6 +141,14 @@ def yield_object_id(tid: "TaskID", index: int) -> ObjectID:
 # weight/checkpoint pulls don't cross the DCN when a local copy exists.
 LABEL_HOST = "raytpu.io/host"
 LABEL_GANG = "raytpu.io/gang"
+# Provider-stamped topology: ``LABEL_SLICE`` is the queued-resource /
+# slice a host belongs to (ICI domain — peers here are one hop away);
+# ``LABEL_DCN`` is the datacenter-network neighborhood (pod/cell), the
+# last locality rung before "anywhere". Providers stamp both at node
+# registration; GangHealer matches replacements on LABEL_SLICE and the
+# stripe-peer picker orders host < slice < gang < dcn < other.
+LABEL_SLICE = "raytpu.io/slice"
+LABEL_DCN = "raytpu.io/dcn"
 
 
 @dataclasses.dataclass

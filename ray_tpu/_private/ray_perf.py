@@ -415,6 +415,101 @@ finally:
 """
 
 
+_MESH_HEAL_BENCH_CODE = """
+import json, time
+import numpy as np
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.mesh import GangHealer, MeshGroup, RankFailedError, StateKey
+
+c = Cluster(
+    initialize_head=True,
+    head_node_args={"resources": {"CPU": 3}},
+    system_config={
+        "prestart_workers": False, "log_to_driver": False,
+        # node death declared after 2s of missed health checks: the
+        # bench measures the HEAL loop, not the default 10s detector
+        "health_check_timeout_ms": 2000,
+    },
+)
+try:
+    n1 = c.add_node(num_cpus=3)
+    c.connect()
+    from ray_tpu.cloud_provider import MockTpuApi, QueuedResourceProvider
+
+    api = MockTpuApi(grant_delay_s=0.3, provision_delay_s=0.2)
+    provider = QueuedResourceProvider(
+        api, accelerator_type="v5p-8",      # 1 host per slice
+        host_resources={"CPU": 3},
+        host_bootstrapper=lambda s, vm, res, labels: c.add_node(
+            resources=res, labels=labels),
+        host_terminator=lambda h: c.remove_node(h),
+    )
+    healer = GangHealer(provider, heal_timeout_s=90.0,
+                        poll_interval_s=0.1)
+
+    def init_state(ctx):
+        import jax, numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        glob = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+        sh = NamedSharding(ctx.mesh, P("dp", "tp"))
+        ctx.state["w"] = jax.make_array_from_callback(
+            glob.shape, sh, lambda idx: glob[idx])
+        return 1
+
+    from jax.sharding import PartitionSpec as P
+
+    def train_step(w, b):
+        w = w + b[:, None]
+        return w, w.sum()
+
+    import tempfile
+    ckpt = tempfile.mkdtemp(prefix="heal_bench") + "/ckpt"
+    mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                   devices_per_host=2, name="bench_heal_gang",
+                   checkpoint_path=ckpt, state_init=init_state,
+                   heal_policy=healer)
+    mg.run(init_state)
+    sid = mg.compile_step_with_plan(
+        train_step, in_shardings=(P("dp", "tp"), P("dp")),
+        out_shardings=(P("dp", "tp"), P()), donate_argnums=(0,))
+    batch = np.ones((8,), np.float32)
+    mg.run_step(sid, StateKey("w"), batch, store={0: "w"})
+    mg.save_state(step=1)
+    # STRICT_SPREAD over exactly {head, n1}: rank on n1 is the victim.
+    t_kill = time.perf_counter()
+    c.remove_node(n1)
+    detect_s = None
+    try:
+        for _ in range(64):
+            mg.run_step(sid, StateKey("w"), batch, store={0: "w"},
+                        timeout=60)
+    except RankFailedError:
+        detect_s = time.perf_counter() - t_kill
+    assert detect_s is not None, "gang never saw the node death"
+    result = mg.heal()
+    assert result["outcome"] == "healed", result
+    assert mg.state == "READY" and mg.hosts == 2, (mg.state, mg.hosts)
+    mg.run_step(sid, StateKey("w"), batch, store={0: "w"})
+    mg.shutdown()
+    print(json.dumps({
+        "detect_s": round(detect_s, 3),
+        "provision_s": round(result["provision_s"], 3),
+        "recover_s": round(result["recover_s"], 3),
+        "mttr_s": round(detect_s + result["provision_s"]
+                        + result["recover_s"], 3),
+        "create_calls": api.create_calls,
+        "healed": 1,
+    }))
+finally:
+    c.shutdown()
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+"""
+
+
 _GCS_PLANE_CODE = """
 import json, os, subprocess, sys, tempfile, threading, time
 
@@ -877,6 +972,17 @@ def run_mesh_group_bench() -> Dict[str, float]:
     steps/s on a 2-host CPU mesh — the lockstep dispatch envelope.
     Subprocess-isolated like the transfer bench."""
     return _run_isolated("mesh group", _MESH_GROUP_BENCH_CODE,
+                         timeout=600)
+
+
+def run_mesh_heal_bench() -> Dict[str, float]:
+    """Self-healing gang micro: SIGKILL one raylet under a 2-host gang,
+    then time each leg of the heal loop — detect (kill to
+    RankFailedError), provision (queued-resource grant + replacement
+    raylet registration with topology labels), recover (full-shape gang
+    rebuild + reshard-restore) — plus the summed MTTR the static
+    ceiling gates on. Subprocess-isolated."""
+    return _run_isolated("mesh heal", _MESH_HEAL_BENCH_CODE,
                          timeout=600)
 
 

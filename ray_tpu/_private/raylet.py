@@ -30,7 +30,13 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import SharedMemoryStore
-from ray_tpu._private.protocol import LABEL_GANG, LABEL_HOST, NodeInfo
+from ray_tpu._private.protocol import (
+    LABEL_DCN,
+    LABEL_GANG,
+    LABEL_HOST,
+    LABEL_SLICE,
+    NodeInfo,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -38,19 +44,22 @@ logger = logging.getLogger(__name__)
 def locality_class(my_labels: Optional[Dict[str, str]],
                    peer_labels: Optional[Dict[str, str]]) -> int:
     """Locality rank of a pull peer from node labels: 0 = same host
-    (``raytpu.io/host`` matches), 1 = same gang (``raytpu.io/gang``
-    matches — a MeshGroup stamps its members), 2 = everything else.
-    Pure label comparison, no I/O: a label a side lacks never matches,
-    so unlabeled clusters keep today's ordering exactly."""
+    (``raytpu.io/host`` matches), 1 = same slice (``raytpu.io/slice``,
+    provider-stamped — ICI-connected peers one hop away), 2 = same gang
+    (``raytpu.io/gang``, MeshGroup-stamped — a gang may span slices),
+    3 = same DCN neighborhood (``raytpu.io/dcn``, provider-stamped pod/
+    cell), 4 = everything else. Pure label comparison, no I/O: a label
+    a side lacks never matches, so unlabeled clusters keep today's
+    ordering exactly."""
     mine = my_labels or {}
     theirs = peer_labels or {}
-    host = mine.get(LABEL_HOST)
-    if host is not None and theirs.get(LABEL_HOST) == host:
-        return 0
-    gang = mine.get(LABEL_GANG)
-    if gang is not None and theirs.get(LABEL_GANG) == gang:
-        return 1
-    return 2
+    for rank, key in enumerate(
+        (LABEL_HOST, LABEL_SLICE, LABEL_GANG, LABEL_DCN)
+    ):
+        val = mine.get(key)
+        if val is not None and theirs.get(key) == val:
+            return rank
+    return 4
 
 
 class _LocationMiss(Exception):
@@ -2897,6 +2906,7 @@ class Raylet:
                 "hosts": rec.get("hosts"),
                 "mesh_shape": rec.get("mesh_shape"),
                 "last_failure": rec.get("last_failure") or "",
+                "heal_state": rec.get("heal_state") or "",
             }
         self._mesh_group_cache = (now, out)
         return out

@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.protocol import LABEL_DCN, LABEL_HOST, LABEL_SLICE
 
 
 class NodeProvider:
@@ -94,18 +95,30 @@ class FakeTpuPodProvider(SliceProvider):
     slice-granular autoscaling."""
 
     def __init__(self, cluster, hosts_per_slice: int = 2,
-                 host_resources: Optional[Dict[str, float]] = None):
+                 host_resources: Optional[Dict[str, float]] = None,
+                 dcn_neighborhood: str = "fake-dcn-0"):
         self.cluster = cluster
         self.hosts_per_slice = hosts_per_slice
         self.host_resources = dict(host_resources or {"CPU": 2, "TPU": 4})
+        self.dcn_neighborhood = dcn_neighborhood
         self._slices: List[List] = []
+        self._counter = 0
 
     def create_slice(self):
+        self._counter += 1
+        slice_name = f"fake-slice-{self._counter}"
         nodes = []
         try:
-            for _ in range(self.hosts_per_slice):
+            for i in range(self.hosts_per_slice):
                 nodes.append(
-                    self.cluster.add_node(resources=dict(self.host_resources))
+                    self.cluster.add_node(
+                        resources=dict(self.host_resources),
+                        labels={
+                            LABEL_SLICE: slice_name,
+                            LABEL_HOST: f"{slice_name}-w{i}",
+                            LABEL_DCN: self.dcn_neighborhood,
+                        },
+                    )
                 )
         except Exception:
             for n in nodes:  # atomicity: all hosts or none
@@ -216,16 +229,23 @@ class TpuSliceAutoscaler:
                 hosts = max(hosts, math.ceil(q / per))
         return hosts
 
-    def update(self):
-        from ray_tpu._private.worker import require_connected
+    def update(self, *, pgs=None, views=None):
+        """One reconcile step. ``pgs``/``views`` are test-injection
+        points (unit tests feed the demand picture directly, no live
+        cluster needed); when omitted, both come from the connected
+        GCS as before."""
+        gcs = None
+        if pgs is None or views is None:
+            from ray_tpu._private.worker import require_connected
 
-        gcs = require_connected().gcs
+            gcs = require_connected().gcs
         # -- gang demand: pending PGs that a slice could satisfy --
         slices_needed = 0
-        try:
-            pgs = gcs.call("placement_group_table", None)
-        except Exception:
-            pgs = []
+        if pgs is None:
+            try:
+                pgs = gcs.call("placement_group_table", None)
+            except Exception:
+                pgs = []
         if isinstance(pgs, dict):
             pgs = list(pgs.values())
         pending_ids = set()
@@ -247,7 +267,8 @@ class TpuSliceAutoscaler:
                     if p not in pending_ids]:
             del self._provisioned_pgs[pid]
         # -- plain unmet resource demand, in whole slices --
-        views = _collect_node_views(gcs)
+        if views is None:
+            views = _collect_node_views(gcs)
         unmet: Dict[str, float] = {}
         for v in views.values():
             for r, q in (v.get("demand") or {}).items():
@@ -255,6 +276,20 @@ class TpuSliceAutoscaler:
         for v in views.values():
             for r, q in (v.get("available") or {}).items():
                 unmet[r] = unmet.get(r, 0.0) - q
+        # credit capacity already in flight: slices whose grant is still
+        # pending (or whose hosts have not registered yet) are invisible
+        # to the node views, so without this a pending replacement gets
+        # double-counted as missing capacity on EVERY reconcile tick and
+        # each tick launches another slice.
+        live = self.provider.non_terminated_slices()
+        in_flight = sum(
+            1 for h in live if not self.provider.node_ids_of(h)
+        )
+        if in_flight:
+            per_host = self.provider.host_resources or {}
+            n_hosts = in_flight * self.provider.hosts_per_slice
+            for r, q in per_host.items():
+                unmet[r] = unmet.get(r, 0.0) - q * n_hosts
         hosts_needed = 0
         for r, q in unmet.items():
             per_host = self.provider.host_resources.get(r, 0.0)
@@ -264,7 +299,6 @@ class TpuSliceAutoscaler:
             hosts_needed / self.provider.hosts_per_slice
         )
         # -- scale up (atomic whole slices) --
-        live = self.provider.non_terminated_slices()
         target_new = min(slices_needed, self.max_slices - len(live))
         for _ in range(max(0, target_new)):
             self.provider.create_slice()

@@ -19,11 +19,13 @@ against ``tpu.googleapis.com`` — nothing else changes.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private.protocol import LABEL_DCN, LABEL_HOST, LABEL_SLICE
 from ray_tpu.autoscaler import SliceProvider
 
 # Queued-resource lifecycle states (subset of the GCP QueuedResourceState
@@ -171,6 +173,36 @@ class MockTpuApi(TpuApiClient):
             ]
 
 
+def _accepts_n_positional(fn: Optional[Callable], n: int) -> bool:
+    """True when ``fn`` can be called with ``n`` positional args."""
+    if fn is None:
+        return False
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    count = 0
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return True
+        if p.kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ):
+            count += 1
+    return count >= n
+
+
+def topology_labels(slice_name: str, host_name: str,
+                    dcn_neighborhood: str) -> Dict[str, str]:
+    """Node labels a provider stamps at registration time."""
+    return {
+        LABEL_SLICE: slice_name,
+        LABEL_HOST: host_name,
+        LABEL_DCN: dcn_neighborhood,
+    }
+
+
 class QueuedResourceProvider(SliceProvider):
     """SliceProvider over the queued-resources API.
 
@@ -197,6 +229,7 @@ class QueuedResourceProvider(SliceProvider):
         name_prefix: str = "raytpu",
         provision_retries: int = 2,
         spot: bool = False,
+        dcn_neighborhood: str = "",
     ):
         self.api = api
         self.accelerator_type = accelerator_type
@@ -210,6 +243,16 @@ class QueuedResourceProvider(SliceProvider):
         self.name_prefix = name_prefix
         self.provision_retries = provision_retries
         self.spot = spot
+        # DCN neighborhood (pod/cell) every slice of this provider lands
+        # in; stamped as raytpu.io/dcn on booted hosts so the stripe-peer
+        # picker can prefer same-cell pulls.
+        self.dcn_neighborhood = dcn_neighborhood or name_prefix
+        # 4-arg bootstrappers additionally receive the topology labels to
+        # register the node with ({slice, host, dcn}); legacy 3-arg
+        # callables keep working unlabeled.
+        self._boot_wants_labels = _accepts_n_positional(
+            host_bootstrapper, 4
+        )
         # slice-handle: mutable dict owned by this provider
         self._slices: List[Dict] = []
         self._lock = threading.RLock()
@@ -229,6 +272,32 @@ class QueuedResourceProvider(SliceProvider):
             "state": qr["state"],
             "retries_left": self.provision_retries,
             "hosts": [],        # bootstrapped host handles
+            "node_ids": [],
+        }
+        with self._lock:
+            self._slices.append(handle)
+        self._reconcile_one(handle)
+        return handle
+
+    def adopt_slice(self, name: str) -> Optional[Dict]:
+        """Adopt an already-filed queued resource instead of filing a
+        duplicate — the GangHealer path after a GCS restart, where the
+        journal-resumed autoscaler intent names a QR this (fresh)
+        provider object has never seen. Returns a live handle tracked
+        like any create_slice product, or None when the API no longer
+        knows the name / it is terminally dead (caller files fresh)."""
+        with self._lock:
+            for h in self._slices:
+                if h["name"] == name:
+                    return h
+        qr = self.api.get_queued_resource(name)
+        if qr is None or qr["state"] in _TERMINAL_DEAD:
+            return None
+        handle = {
+            "name": name,
+            "state": qr["state"],
+            "retries_left": self.provision_retries,
+            "hosts": [],
             "node_ids": [],
         }
         with self._lock:
@@ -304,9 +373,18 @@ class QueuedResourceProvider(SliceProvider):
         hosts, node_ids = [], []
         try:
             for vm in self.api.list_nodes(handle["name"]):
-                h = self.host_bootstrapper(
-                    handle["name"], vm, dict(self.host_resources)
-                )
+                if self._boot_wants_labels:
+                    h = self.host_bootstrapper(
+                        handle["name"], vm, dict(self.host_resources),
+                        topology_labels(
+                            handle["name"], vm["name"],
+                            self.dcn_neighborhood,
+                        ),
+                    )
+                else:
+                    h = self.host_bootstrapper(
+                        handle["name"], vm, dict(self.host_resources)
+                    )
                 hosts.append(h)
         except Exception:
             # atomicity: a slice whose hosts half-booted is torn down and

@@ -28,7 +28,9 @@ import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
+from ray_tpu._private import chaos
 from ray_tpu.cloud_provider import TpuApiClient
+from ray_tpu.exceptions import ProvisionError
 
 _METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/"
@@ -81,8 +83,12 @@ class RestTpuApi(TpuApiClient):
     """The five ``TpuApiClient`` calls over the v2 REST surface.
 
     ``base_url`` defaults to the public endpoint; tests point it at a
-    local fake. Transient HTTP failures (5xx, URLError) retry with
-    backoff; 4xx raise immediately (a bad request never heals)."""
+    local fake. Transient HTTP failures (429/5xx, connection resets)
+    retry with decorrelated jitter seeded off ``chaos.replay_rng`` —
+    under a chaos plane the backoff schedule replays bit-for-bit; a
+    ``Retry-After`` header wins over the computed delay. Exhaustion and
+    non-heal 4xx raise typed ``ProvisionError`` with the final attempt
+    chained (``from e``) — never a blank timeout."""
 
     def __init__(
         self,
@@ -102,6 +108,9 @@ class RestTpuApi(TpuApiClient):
 
     # -- HTTP plumbing --
 
+    _BACKOFF_BASE_S = 0.2
+    _BACKOFF_CAP_S = 10.0
+
     def _request(self, method: str, path: str,
                  body: Optional[Dict] = None,
                  query: Optional[Dict] = None) -> Dict:
@@ -109,12 +118,18 @@ class RestTpuApi(TpuApiClient):
         if query:
             url += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
+        # decorrelated jitter (AWS-style): sleep ~ U(base, prev*3),
+        # capped. Seeded per (method, path) so concurrent callers spread
+        # out, yet a chaos replay reproduces the exact schedule.
+        rng = chaos.replay_rng(f"tpu_api:{method}:{path}")
+        sleep_s = self._BACKOFF_BASE_S
         last: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Authorization", f"Bearer {self.token.get()}")
             if data is not None:
                 req.add_header("Content-Type", "application/json")
+            retry_after: Optional[float] = None
             try:
                 with urllib.request.urlopen(
                     req, timeout=self.timeout_s
@@ -128,17 +143,40 @@ class RestTpuApi(TpuApiClient):
                     # ALREADY_EXISTS: a retried create whose first POST
                     # actually landed — the caller resolves via GET
                     raise FileExistsError(path) from e
-                if e.code < 500:
-                    raise RuntimeError(
-                        f"TPU API {method} {path}: HTTP {e.code} "
-                        f"{e.read()[:200]!r}"
+                if e.code != 429 and e.code < 500:
+                    raise ProvisionError(
+                        op=f"{method} {path}",
+                        detail=f"HTTP {e.code} {e.read()[:200]!r}",
+                        attempts=attempt + 1,
+                        retryable=False,
                     ) from e
+                if e.code == 429:
+                    ra = e.headers.get("Retry-After") if e.headers else None
+                    try:
+                        retry_after = float(ra) if ra is not None else None
+                    except ValueError:
+                        retry_after = None
                 last = e
             except urllib.error.URLError as e:
+                # covers ConnectionResetError & friends (urlopen wraps
+                # socket errors in URLError with .reason set)
+                last = e
+            except ConnectionError as e:
+                # resets surfacing mid-read, after urlopen returned
                 last = e
             if attempt < self.retries:
-                time.sleep(0.5 * (2 ** attempt))
-        raise ConnectionError(f"TPU API {method} {path} failed: {last!r}")
+                sleep_s = min(
+                    self._BACKOFF_CAP_S,
+                    rng.uniform(self._BACKOFF_BASE_S, sleep_s * 3),
+                )
+                time.sleep(retry_after if retry_after is not None
+                           else sleep_s)
+        raise ProvisionError(
+            op=f"{method} {path}",
+            detail=repr(last),
+            attempts=self.retries + 1,
+            retryable=True,
+        ) from last
 
     # -- wire <-> provider dict --
 

@@ -147,6 +147,35 @@ class BackpressureError(RayTpuError):
         )
 
 
+class ProvisionError(RayTpuError):
+    """Cloud provisioning (queued-resource / TPU REST) call failed after
+    bounded retries. Always carries the final attempt chained via
+    ``raise ... from e`` so callers see the HTTP/connection root cause
+    instead of a blank timeout. ``retryable`` marks transient classes
+    (429/5xx/resets) where a fresh request later may succeed; quota or
+    malformed-request errors come back with ``retryable = False``."""
+
+    retryable = True
+
+    def __init__(self, op: str = "", detail: str = "", attempts: int = 0,
+                 retryable: bool = True):
+        self.op = op
+        self.detail = detail
+        self.attempts = int(attempts)
+        self.retryable = bool(retryable)
+        super().__init__(
+            f"provisioning {op} failed"
+            + (f" after {attempts} attempts" if attempts else "")
+            + (f": {detail}" if detail else "")
+        )
+
+    def __reduce__(self):
+        return (
+            type(self),
+            (self.op, self.detail, self.attempts, self.retryable),
+        )
+
+
 class ReplicaUnavailableError(RayTpuError):
     """The replica serving an in-flight (already dispatched) request or
     stream died mid-work. The request MAY have partially executed —

@@ -31,6 +31,14 @@ from ray_tpu.mesh.group import (  # noqa: F401
     RankFailedError,
     StateKey,
 )
+from ray_tpu.mesh.heal import (  # noqa: F401
+    DEGRADED,
+    HEALING,
+    RECOVERING,
+    WAITING_HOST,
+    GangHealer,
+    shrink_mesh_shape,
+)
 from ray_tpu.mesh.plan import (  # noqa: F401
     PlanError,
     compile_step_with_plan,
@@ -43,6 +51,12 @@ from ray_tpu.mesh.plan import (  # noqa: F401
 
 __all__ = [
     "MeshGroup",
+    "GangHealer",
+    "shrink_mesh_shape",
+    "HEALING",
+    "WAITING_HOST",
+    "RECOVERING",
+    "DEGRADED",
     "MeshGroupError",
     "MeshWorkerContext",
     "RankFailedError",

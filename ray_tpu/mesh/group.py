@@ -344,10 +344,17 @@ class MeshGroup:
         env: Optional[Dict[str, str]] = None,
         checkpoint_path: Optional[str] = None,
         state_init: Optional[Callable] = None,
+        heal_policy: Optional[Any] = None,
     ):
         if hosts < 1:
             raise ValueError("hosts must be >= 1")
         self.name = name or _auto_name()
+        # heal policy (mesh.heal.GangHealer): notified on rank death to
+        # file a replacement host; drives heal() back to READY at the
+        # original shape. ``heal_state`` mirrors its FSM into the
+        # registry ("" when no heal is in flight).
+        self.heal_policy = heal_policy
+        self.heal_state = ""
         self.hosts = hosts
         self.axis_names, self.sizes = normalize_mesh_shape(
             mesh_shape, axis_names
@@ -578,6 +585,20 @@ class MeshGroup:
             rank = dead[0] if dead else min(failures)
             self._break_gang(f"{what}: rank {rank} failed: "
                              f"{failures[rank]!r}")
+            if self.heal_policy is not None:
+                # fire the replacement request BEFORE the typed error
+                # propagates: provisioning latency (minutes on a real
+                # cloud) starts now, overlapping the caller's decision
+                # to heal(). Never lets a policy bug mask the failure.
+                try:
+                    self.heal_policy.note_failure(
+                        self, rank, failures[rank]
+                    )
+                except Exception:
+                    logger.exception(
+                        "mesh group %s: heal policy note_failure failed",
+                        self.name,
+                    )
             raise RankFailedError(
                 self.name, rank, self.epoch, cause=failures[rank]
             ) from failures[rank]
@@ -834,7 +855,28 @@ class MeshGroup:
             "steps_run": self.steps_run,
             "members": [m.get("node_id") for m in self.members],
             "last_failure": self.last_failure,
+            "heal_state": self.heal_state,
         }
+
+    def status(self) -> Dict[str, Any]:
+        """Gang status incl. the heal FSM state (HEALING / WAITING_HOST
+        / RECOVERING / DEGRADED, "" when no heal is in flight) — the
+        same record the GCS mesh-group registry and member ``node_
+        stats`` surface, so tests and dashboards observe the loop
+        instead of polling exceptions."""
+        return self.stats()
+
+    def heal(self, **kwargs) -> Dict[str, Any]:
+        """Drive the configured heal policy: wait (bounded) for the
+        replacement host filed at failure time, then recover() at the
+        ORIGINAL mesh shape — or shrink-recover when ``heal_timeout_s``
+        expires. Requires ``heal_policy=`` at construction."""
+        if self.heal_policy is None:
+            raise MeshGroupError(
+                f"mesh group {self.name!r} has no heal_policy — pass "
+                f"heal_policy=GangHealer(provider) to the constructor"
+            )
+        return self.heal_policy.heal(self, **kwargs)
 
     def _registry_record(self) -> Dict[str, Any]:
         return {
@@ -849,6 +891,7 @@ class MeshGroup:
             "ranks": {m.get("node_id"): i
                       for i, m in enumerate(self.members)},
             "last_failure": self.last_failure,
+            "heal_state": self.heal_state,
         }
 
     def _gcs_call(self, method: str, payload,

@@ -31,11 +31,22 @@ _NODE_RE = re.compile(
 
 
 class QrApiFake:
-    """The server plus knobs the tests turn (fail_next_http -> 500s)."""
+    """The server plus knobs the tests turn:
+
+    - ``fail_next_http``  -> next N API requests answer 500
+    - ``throttle_next``   -> next N answer 429 (with ``retry_after_s``
+      stamped into a Retry-After header when set)
+    - ``reset_next``      -> next N have their connection torn down
+      mid-response (client sees a connection reset / short read)
+    """
 
     def __init__(self, **mock_kwargs):
         self.mock = MockTpuApi(**mock_kwargs)
         self.fail_next_http = 0
+        self.fail_next_http_code = 500   # status fail_next_http answers
+        self.throttle_next = 0
+        self.retry_after_s = None
+        self.reset_next = 0
         self.requests_seen = []  # (method, path) log
         self.token_fetches = 0
         fake = self
@@ -79,9 +90,28 @@ class QrApiFake:
                 if self.headers.get("Authorization") != f"Bearer {TOKEN}":
                     self._json(401, {"error": "bad or missing token"})
                     return False
+                if fake.reset_next > 0:
+                    fake.reset_next -= 1
+                    # abort the socket without an HTTP response: the
+                    # client's read raises ConnectionReset/BadStatusLine
+                    self.connection.close()
+                    return False
+                if fake.throttle_next > 0:
+                    fake.throttle_next -= 1
+                    body = json.dumps({"error": "rate limited"}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    if fake.retry_after_s is not None:
+                        self.send_header("Retry-After",
+                                         str(fake.retry_after_s))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return False
                 if fake.fail_next_http > 0:
                     fake.fail_next_http -= 1
-                    self._json(500, {"error": "injected transient"})
+                    self._json(fake.fail_next_http_code,
+                               {"error": "injected failure"})
                     return False
                 return True
 
@@ -166,7 +196,13 @@ class QrApiFake:
                     return
                 self._json(404, {"error": f"no route {parsed.path}"})
 
-        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        class QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # injected connection resets make the handler thread
+                # raise on its closed socket — expected, keep quiet
+                pass
+
+        self.server = QuietServer(("127.0.0.1", 0), Handler)
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(
             target=self.server.serve_forever, daemon=True

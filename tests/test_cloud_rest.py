@@ -71,6 +71,60 @@ def test_rest_retries_transient_500(fake):
     assert qr["state"] == "WAITING_FOR_RESOURCES"
 
 
+def test_rest_429_retry_honors_retry_after(fake):
+    api = _client(fake, retries=3)
+    fake.throttle_next = 2
+    fake.retry_after_s = 0.05
+    t0 = time.monotonic()
+    qr = api.create_queued_resource(
+        "qr1", accelerator_type="v5p-8", runtime_version="rt"
+    )
+    elapsed = time.monotonic() - t0
+    assert qr["state"] == "WAITING_FOR_RESOURCES"
+    posts = [p for m, p in fake.requests_seen if m == "POST"]
+    assert len(posts) == 3  # two 429s + the success
+    # Retry-After won over the jitter schedule: two 0.05s sleeps, where
+    # the decorrelated-jitter floor alone would be >= 0.2s per retry
+    assert 0.09 <= elapsed < 0.35, elapsed
+
+
+def test_rest_connection_reset_retries(fake):
+    api = _client(fake, retries=2)
+    api.create_queued_resource(
+        "qr1", accelerator_type="v5p-8", runtime_version="rt"
+    )
+    fake.reset_next = 1  # tear down the next connection mid-response
+    got = api.get_queued_resource("qr1")
+    assert got is not None and got["name"] == "qr1"
+
+
+def test_rest_exhaustion_raises_typed_chain(fake):
+    from ray_tpu.exceptions import ProvisionError
+
+    api = _client(fake, retries=1)
+    fake.fail_next_http = 5
+    with pytest.raises(ProvisionError) as ei:
+        api.list_queued_resources()
+    assert ei.value.retryable is True
+    assert ei.value.attempts == 2  # first try + one retry
+    assert ei.value.__cause__ is not None  # final attempt chained
+
+
+def test_rest_non_retryable_4xx_fails_fast(fake):
+    from ray_tpu.exceptions import ProvisionError
+
+    api = _client(fake, retries=3)
+    fake.fail_next_http = 3
+    fake.fail_next_http_code = 403
+    with pytest.raises(ProvisionError) as ei:
+        api.list_queued_resources()
+    assert ei.value.retryable is False
+    assert ei.value.attempts == 1  # a 403 never burns the retry budget
+    gets = [p for m, p in fake.requests_seen
+            if m == "GET" and p.endswith("queuedResources")]
+    assert len(gets) == 1
+
+
 def test_rest_spot_rides_the_wire(fake):
     api = _client(fake)
     qr = api.create_queued_resource(

@@ -430,6 +430,9 @@ def test_node_label_scheduling_strategy():
         initialize_head=True,
         head_node_args={"resources": {"CPU": 2},
                         "labels": {"accel": "cpu"}},
+        # the unmatched-labels leg waits out the full infeasible grace
+        # window before the explicit error surfaces — shrink it
+        system_config={"infeasible_task_grace_s": 3.0},
     )
     try:
         v5e = c.add_node(num_cpus=2, labels={"accel": "tpu-v5e",

@@ -1,0 +1,440 @@
+"""Self-healing gangs (r15 tentpole): the RankFailedError -> autoscaler
+-> full-shape recovery loop.
+
+Covers the elastic compute plane's acceptance surface: a genuine node
+death under a live gang files EXACTLY ONE replacement queued-resource
+request (journaled as a GCS autoscaler intent), the replacement raylet
+registers wearing ``raytpu.io/slice`` topology labels, ``heal()``
+returns the gang to READY at the ORIGINAL mesh shape and the resumed
+losses match a no-failure numpy continuation bitwise; a stockout past
+``heal_timeout_s`` shrink-recovers (DEGRADED, pending QR cancelled, no
+wedge); the heal FSM is observable through ``status()``, the GCS
+mesh-group registry and member ``node_stats``; and the autoscaler's
+reconcile tick credits in-flight slices so a pending replacement is not
+double-provisioned.  The slow soak leg SIGKILLs the GCS mid-heal and
+proves the journal-resumed intent is adopted — zero duplicate queued
+resources, zero leaked placement-group slots.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.protocol import LABEL_HOST, LABEL_SLICE
+from ray_tpu._private.worker import require_connected
+from ray_tpu.cloud_provider import MockTpuApi, QueuedResourceProvider
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.mesh import (
+    DEGRADED,
+    HEALING,
+    WAITING_HOST,
+    GangHealer,
+    MeshGroup,
+    RankFailedError,
+    StateKey,
+    shrink_mesh_shape,
+)
+from tests.test_mesh_group import _compile_train_step, _make_init_state
+
+
+def _mk_cluster(**sys_cfg):
+    """Head + one removable 'host', with node death declared after 2s
+    of missed health checks (the default 10s would dominate every
+    bounded-heal assertion below)."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 3},
+                        "labels": {LABEL_HOST: "h0"}},
+        system_config={"health_check_timeout_ms": 2000, **sys_cfg},
+    )
+    n1 = c.add_node(num_cpus=3, labels={LABEL_HOST: "h1"})
+    c.connect()
+    return c, n1
+
+
+def _mk_provider(c, api, added=None):
+    """Provider whose 'cloud hosts' are simulated cluster nodes; the
+    4-positional bootstrapper receives provider-stamped topology labels
+    (slice/host/dcn) exactly as a production node launcher would."""
+
+    def boot(slice_name, vm, res, labels):
+        node = c.add_node(resources=res, labels=labels)
+        if added is not None:
+            added.append(node)
+        return node
+
+    return QueuedResourceProvider(
+        api,
+        accelerator_type="v5p-8",  # 1 host per slice
+        host_resources={"CPU": 3},
+        host_bootstrapper=boot,
+        host_terminator=c.remove_node,
+    )
+
+
+def _intent_table():
+    return require_connected().gcs.call(
+        "autoscaler_intent_table", None, timeout=10
+    ) or {}
+
+
+def _train_to_checkpoint(mg, sid, steps=3):
+    """Run ``steps`` integral steps, checkpoint, and return the numpy
+    mirror of the post-checkpoint weights (losses computed from it
+    compare bitwise with the healed gang's)."""
+    batch = np.ones((8,), np.float32)
+    for _ in range(steps):
+        mg.run_step(sid, StateKey("w"), batch, store={0: "w"})
+    mg.save_state(step=steps)
+    return np.arange(32, dtype=np.float32).reshape(8, 4) + float(steps)
+
+
+# ---------------- tier-1: the full heal loop ----------------
+
+
+def test_rank_death_files_one_slice_and_heals_full_shape(tmp_path):
+    c, n1 = _mk_cluster()
+    try:
+        api = MockTpuApi(grant_delay_s=0.2, provision_delay_s=0.1)
+        healer = GangHealer(_mk_provider(c, api), heal_timeout_s=60.0,
+                            poll_interval_s=0.1)
+        mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                       devices_per_host=2, name="gang_heal",
+                       checkpoint_path=str(tmp_path / "ckpt"),
+                       state_init=_make_init_state(),
+                       heal_policy=healer)
+        try:
+            mg.run(_make_init_state())
+            sid = _compile_train_step(mg)
+            w = _train_to_checkpoint(mg, sid)
+            expect = []
+            for _ in range(3):
+                w = w + 1.0
+                expect.append(float(w.sum()))
+            # whole-node death: the raylet under one rank is SIGKILLed
+            # (fate-shared workers die with it) — full-shape recovery
+            # genuinely requires a replacement host
+            c.remove_node(n1)
+            batch = np.ones((8,), np.float32)
+            with pytest.raises(RankFailedError):
+                for _ in range(64):
+                    mg.run_step(sid, StateKey("w"), batch,
+                                store={0: "w"}, timeout=60)
+            # note_failure already filed EXACTLY ONE queued resource,
+            # with the intent journaled durably in the GCS
+            assert api.create_calls == 1
+            assert mg.heal_state == HEALING
+            assert mg.status()["heal_state"] == HEALING
+            intent = _intent_table()["heal:gang_heal"]
+            assert intent["state"] == "PENDING" and intent["slice"]
+            table = require_connected().gcs.call(
+                "mesh_group_table", None, timeout=10
+            )
+            assert table["gang_heal"]["heal_state"] == HEALING
+            # ...and through a surviving member's node_stats (raylet
+            # mirrors the registry on a 2s cache — poll briefly)
+            from ray_tpu._private import rpc
+
+            cli = rpc.Client.connect(c.head_node.raylet_addr,
+                                     name="heal-stats")
+            try:
+                deadline = time.monotonic() + 10
+                hs = None
+                while time.monotonic() < deadline:
+                    ns = cli.call("node_stats", None, timeout=30)
+                    hs = (ns.get("mesh_groups") or {}).get(
+                        "gang_heal", {}).get("heal_state")
+                    if hs == HEALING:
+                        break
+                    time.sleep(0.5)
+                assert hs == HEALING, hs
+            finally:
+                cli.close()
+            # the heal FSM is observable mid-flight via status()
+            seen = set()
+            stop = threading.Event()
+
+            def watch():
+                while not stop.is_set():
+                    seen.add(mg.status().get("heal_state"))
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=watch, daemon=True)
+            t.start()
+            try:
+                result = mg.heal()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+            assert result["outcome"] == "healed", result
+            assert WAITING_HOST in seen, seen
+            # READY at the ORIGINAL shape on a full replacement host
+            assert mg.state == "READY" and mg.hosts == 2
+            assert dict(zip(mg.axis_names, mg.sizes)) == {"dp": 2,
+                                                          "tp": 2}
+            assert api.create_calls == 1  # still exactly one — no dupes
+            assert "heal:gang_heal" not in _intent_table()  # no leak
+            assert mg.heal_state == ""
+            # the replacement registered wearing provider-stamped
+            # topology labels matching the filed queued resource
+            labeled = [
+                n for n in ray_tpu.nodes()
+                if n.get("alive", True)
+                and (n.get("labels") or {}).get(LABEL_SLICE)
+                == intent["slice"]
+            ]
+            assert labeled, "replacement host carries no slice label"
+            got = []
+            for _ in range(3):
+                (loss,) = mg.run_step(sid, StateKey("w"), batch,
+                                      store={0: "w"})
+                got.append(float(loss))
+            assert got == expect, (got, expect)  # bitwise continuation
+            assert result["mttr_s"] > 0 and result["recover_s"] > 0
+        finally:
+            mg.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_heal_timeout_shrink_recovers_without_wedging(tmp_path):
+    """A stockout past heal_timeout_s must degrade, not wedge: the
+    pending queued resource is cancelled, the intent journal entry is
+    cleaned up, and the gang resumes at a shrunken shape on the
+    surviving host — losses still bitwise-match the checkpoint
+    continuation (reshard-restore is shape-agnostic)."""
+    c, n1 = _mk_cluster()
+    try:
+        api = MockTpuApi()
+        api.stockout = True  # grants never land
+        healer = GangHealer(_mk_provider(c, api), heal_timeout_s=1.5,
+                            poll_interval_s=0.1)
+        mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                       devices_per_host=2, name="gang_shrink",
+                       checkpoint_path=str(tmp_path / "ckpt"),
+                       state_init=_make_init_state(),
+                       heal_policy=healer)
+        try:
+            mg.run(_make_init_state())
+            sid = _compile_train_step(mg)
+            w = _train_to_checkpoint(mg, sid)
+            c.remove_node(n1)
+            batch = np.ones((8,), np.float32)
+            with pytest.raises(RankFailedError):
+                for _ in range(64):
+                    mg.run_step(sid, StateKey("w"), batch,
+                                store={0: "w"}, timeout=60)
+            assert api.create_calls == 1
+            result = mg.heal()
+            assert result["outcome"] == "degraded", result
+            assert mg.heal_state == DEGRADED
+            assert mg.status()["heal_state"] == DEGRADED
+            # shrunken shape, one surviving host, still computing
+            assert mg.hosts == 1
+            assert dict(zip(mg.axis_names, mg.sizes)) == {"dp": 1,
+                                                          "tp": 2}
+            assert mg.state == "READY"
+            assert api.delete_calls == 1  # pending QR cancelled
+            assert "heal:gang_shrink" not in _intent_table()
+            for _ in range(2):
+                w = w + 1.0
+                (loss,) = mg.run_step(sid, StateKey("w"), batch,
+                                      store={0: "w"})
+                assert float(loss) == float(w.sum())
+        finally:
+            mg.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_shrink_mesh_shape_unit():
+    assert shrink_mesh_shape(("dp", "tp"), (2, 2), 2, 1) == {"dp": 1,
+                                                             "tp": 2}
+    assert shrink_mesh_shape(("dp", "tp"), (4, 2), 4, 2) == {"dp": 2,
+                                                             "tp": 2}
+    assert shrink_mesh_shape(("dp",), (8,), 4, 1) == {"dp": 2}
+    from ray_tpu.mesh import MeshGroupError
+
+    # host ratio 3 -> 1 does not divide a dp2xtp2 shape: typed error,
+    # never a silently-wrong mesh
+    with pytest.raises(MeshGroupError):
+        shrink_mesh_shape(("dp", "tp"), (2, 2), 3, 1)
+
+
+def test_heal_loop_over_http_fake(tmp_path):
+    """Same heal loop, but the provider speaks to the queued-resources
+    API through the real urllib client against the HTTP fake — the
+    provisioning wire path (ADC token, retries, typed errors) rides in
+    the loop exactly as it would against tpu.googleapis.com."""
+    from ray_tpu.cloud_rest import RestTpuApi
+    from tests.qr_api_fake import QrApiFake
+
+    fake = QrApiFake(grant_delay_s=0.2, provision_delay_s=0.1).start()
+    c, n1 = _mk_cluster()
+    try:
+        api = RestTpuApi(project="p", zone="z", base_url=fake.base_url,
+                         token_url=fake.token_url)
+
+        def boot(slice_name, vm, res, labels):
+            return c.add_node(resources=res, labels=labels)
+
+        provider = QueuedResourceProvider(
+            api, accelerator_type="v5p-8", host_resources={"CPU": 3},
+            host_bootstrapper=boot, host_terminator=c.remove_node,
+        )
+        healer = GangHealer(provider, heal_timeout_s=60.0,
+                            poll_interval_s=0.1)
+        mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                       devices_per_host=2, name="gang_http",
+                       checkpoint_path=str(tmp_path / "ckpt"),
+                       state_init=_make_init_state(),
+                       heal_policy=healer)
+        try:
+            mg.run(_make_init_state())
+            sid = _compile_train_step(mg)
+            _train_to_checkpoint(mg, sid)
+            c.remove_node(n1)
+            batch = np.ones((8,), np.float32)
+            with pytest.raises(RankFailedError):
+                for _ in range(64):
+                    mg.run_step(sid, StateKey("w"), batch,
+                                store={0: "w"}, timeout=60)
+            result = mg.heal()
+            assert result["outcome"] == "healed", result
+            assert mg.hosts == 2
+            assert dict(zip(mg.axis_names, mg.sizes)) == {"dp": 2,
+                                                          "tp": 2}
+            # the request really crossed the wire, exactly once
+            assert fake.mock.create_calls == 1
+            assert any(m == "POST" for m, _ in fake.requests_seen)
+        finally:
+            mg.shutdown()
+    finally:
+        c.shutdown()
+        fake.stop()
+
+
+# ---------------- autoscaler: in-flight slice fit-check ----------------
+
+
+def test_autoscaler_credits_in_flight_slices():
+    """A slice whose cloud grant is still pending is invisible to the
+    node views; without the in-flight credit every reconcile tick
+    re-counts the same unmet demand and launches another slice."""
+    from ray_tpu.autoscaler import TpuSliceAutoscaler
+
+    api = MockTpuApi(grant_delay_s=60.0)  # grant never lands in-test
+    provider = QueuedResourceProvider(
+        api, accelerator_type="v5p-8", host_resources={"CPU": 3}
+    )
+    scaler = TpuSliceAutoscaler(provider, max_slices=4)
+    views = {"aa": {"demand": {"CPU": 3}, "available": {},
+                    "total": {}}}
+    scaler.update(pgs=[], views=views)
+    assert scaler.num_slice_launches == 1 and api.create_calls == 1
+    for _ in range(5):
+        scaler.update(pgs=[], views=views)
+    # the pending replacement was credited, not double-counted
+    assert scaler.num_slice_launches == 1 and api.create_calls == 1
+
+
+# ---------------- slow soak: seeded kills + GCS SIGKILL mid-heal -------
+
+
+@pytest.mark.slow
+def test_soak_repeated_kills_and_gcs_sigkill_mid_heal(tmp_path):
+    """Two kill->heal cycles; the second SIGKILLs the GCS between the
+    RankFailedError (intent journaled PENDING) and heal(), then swaps
+    in a FRESH healer over a FRESH provider sharing only the cloud API:
+    the journal-resumed intent must be adopted — the queued-resource
+    count stays one-per-failure (no duplicate provisioning), no intent
+    leaks, and no placement-group slots leak. The durable file backend
+    is what makes the intent journal survive the SIGKILL."""
+    c, n1 = _mk_cluster(gcs_storage_backend="file")
+    try:
+        api = MockTpuApi(grant_delay_s=0.3, provision_delay_s=0.1)
+        added = []
+        healer = GangHealer(_mk_provider(c, api, added),
+                            heal_timeout_s=60.0, poll_interval_s=0.1)
+        mg = MeshGroup(hosts=2, mesh_shape={"dp": 2, "tp": 2},
+                       devices_per_host=2, name="gang_soak",
+                       checkpoint_path=str(tmp_path / "ckpt"),
+                       state_init=_make_init_state(),
+                       heal_policy=healer)
+        try:
+            mg.run(_make_init_state())
+            sid = _compile_train_step(mg)
+            batch = np.ones((8,), np.float32)
+            w = np.arange(32, dtype=np.float32).reshape(8, 4)
+            step = 0
+            victim = n1
+            for round_i in range(2):
+                for _ in range(2):
+                    (loss,) = mg.run_step(sid, StateKey("w"), batch,
+                                          store={0: "w"})
+                    w = w + 1.0
+                    step += 1
+                    assert float(loss) == float(w.sum())
+                mg.save_state(step=step)
+                c.remove_node(victim)
+                with pytest.raises(RankFailedError):
+                    for _ in range(64):
+                        mg.run_step(sid, StateKey("w"), batch,
+                                    store={0: "w"}, timeout=60)
+                assert api.create_calls == round_i + 1
+                if round_i == 1:
+                    # GCS SIGKILL mid-heal: the PENDING intent survives
+                    # in the journal; a fresh healer + fresh provider
+                    # (new driver, same cloud) must ADOPT it
+                    c._impl.restart_gcs()
+                    gcs = require_connected().gcs
+                    deadline = time.monotonic() + 30
+                    table = None
+                    while time.monotonic() < deadline:
+                        try:
+                            table = gcs.call("autoscaler_intent_table",
+                                             None, timeout=5)
+                            if table and "heal:gang_soak" in table:
+                                break
+                        except Exception:
+                            pass
+                        time.sleep(0.3)
+                    assert table and "heal:gang_soak" in table, (
+                        "journaled intent lost across GCS restart"
+                    )
+                    mg.heal_policy = GangHealer(
+                        _mk_provider(c, api, added),
+                        heal_timeout_s=60.0, poll_interval_s=0.1,
+                    )
+                created_before = api.create_calls
+                result = mg.heal()
+                assert result["outcome"] == "healed", result
+                # adopted, not re-filed: zero duplicate queued resources
+                assert api.create_calls == created_before
+                assert mg.hosts == 2
+                assert dict(zip(mg.axis_names, mg.sizes)) == {
+                    "dp": 2, "tp": 2}
+                assert "heal:gang_soak" not in _intent_table()
+                victim = added[-1]  # next round kills the replacement
+            # losses still bitwise-track the numpy mirror post-soak
+            for _ in range(2):
+                (loss,) = mg.run_step(sid, StateKey("w"), batch,
+                                      store={0: "w"})
+                w = w + 1.0
+                assert float(loss) == float(w.sum())
+            # no leaked placement-group slots: exactly the gang's PG
+            pgs = require_connected().gcs.call(
+                "placement_group_table", None, timeout=10
+            )
+            if isinstance(pgs, dict):
+                pgs = list(pgs.values())
+            live_pgs = [p for p in pgs or []
+                        if p.get("state") not in ("REMOVED",)]
+            assert len(live_pgs) == 1, live_pgs
+        finally:
+            mg.shutdown()
+    finally:
+        c.shutdown()

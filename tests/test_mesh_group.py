@@ -311,17 +311,22 @@ def test_gang_rendezvous_under_link_chaos(tmp_path):
 
 
 def test_locality_class_ordering_unit():
+    from ray_tpu._private.protocol import LABEL_DCN, LABEL_SLICE
     from ray_tpu._private.raylet import locality_class
 
-    me = {LABEL_HOST: "hA", LABEL_GANG: "g1"}
+    me = {LABEL_HOST: "hA", LABEL_SLICE: "s1", LABEL_GANG: "g1",
+          LABEL_DCN: "d1"}
     assert locality_class(me, {LABEL_HOST: "hA"}) == 0
-    assert locality_class(me, {LABEL_HOST: "hB", LABEL_GANG: "g1"}) == 1
-    assert locality_class(me, {LABEL_HOST: "hB", LABEL_GANG: "g2"}) == 2
-    assert locality_class(me, {}) == 2
-    assert locality_class(me, None) == 2
+    assert locality_class(me, {LABEL_HOST: "hB", LABEL_SLICE: "s1"}) == 1
+    assert locality_class(me, {LABEL_HOST: "hB", LABEL_SLICE: "s2",
+                               LABEL_GANG: "g1"}) == 2
+    assert locality_class(me, {LABEL_GANG: "g2", LABEL_DCN: "d1"}) == 3
+    assert locality_class(me, {LABEL_DCN: "d2"}) == 4
+    assert locality_class(me, {}) == 4
+    assert locality_class(me, None) == 4
     # unlabeled puller: nothing matches — today's ordering untouched
-    assert locality_class({}, {LABEL_HOST: "hA"}) == 2
-    assert locality_class(None, None) == 2
+    assert locality_class({}, {LABEL_HOST: "hA"}) == 4
+    assert locality_class(None, None) == 4
 
 
 def test_pull_prefers_same_host_labeled_peer():

@@ -329,6 +329,40 @@ def test_r3_covers_raylet_fanout_sends():
     assert findings == []
 
 
+def test_r9_covers_heal_and_provisioning_modules(tmp_path):
+    """R9's scope widens to mesh/ and the provisioning client/driver
+    (autoscaler.py, cloud_rest.py) in r15: the heal loop swallows-and-
+    degrades by design, so any raise it DOES emit must carry its chain
+    — an unchained raise in a provisioning except handler is exactly
+    the blank-timeout class the self-healing acceptance forbids."""
+    bad = textwrap.dedent(
+        """
+        def file_slice(self):
+            try:
+                return self.api.create_queued_resource("qr")
+            except OSError:
+                raise RuntimeError("provisioning failed")
+        """
+    )
+    good = textwrap.dedent(
+        """
+        def file_slice(self):
+            try:
+                return self.api.create_queued_resource("qr")
+            except OSError as e:
+                raise RuntimeError("provisioning failed") from e
+        """
+    )
+    for path in ("mesh/heal.py", "autoscaler.py", "cloud_rest.py"):
+        findings, _ = lint_source(bad, path)
+        assert any(f.rule == "R9" for f in findings), path
+        findings, _ = lint_source(good, path)
+        assert [f for f in findings if f.rule == "R9"] == [], path
+    # outside the widened scope the rule stays silent
+    findings, _ = lint_source(bad, "util/misc_helpers.py")
+    assert [f for f in findings if f.rule == "R9"] == []
+
+
 def test_r4_covers_serve_router_randomness():
     """R4 extends to serve/router.py (r9): replica picks are routing
     decisions a replayed chaos schedule must meet again, so the router

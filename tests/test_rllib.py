@@ -35,7 +35,8 @@ def test_rollout_worker_batch_shapes():
     assert np.isfinite(b["returns"]).all()
 
 
-def test_ppo_cartpole_reaches_450(rt_rl):
+@pytest.mark.slow  # ~37s learn-to-threshold run; dqn/impala-multi keep
+def test_ppo_cartpole_reaches_450(rt_rl):  # rllib in tier-1
     algo = PPOConfig(
         env="CartPole-v1",
         num_workers=2,
@@ -105,6 +106,7 @@ def test_vtrace_matches_manual():
     np.testing.assert_allclose(float(vs4[1]), 2.0 + (1.0 - 2.0), rtol=1e-6)
 
 
+@pytest.mark.slow  # ~46s learn-to-threshold run (see note on the ppo test)
 def test_impala_learns_cartpole_async(rt_rl):
     algo = IMPALAConfig(
         env="CartPole-v1", num_workers=2, rollout_len=512, lr=6e-4, seed=0,

@@ -65,7 +65,9 @@ are ``async def`` / loop-inline-marked sync defs under ``_private/``
 (the taint itself follows the call graph into any module); R8 applies
 everywhere an await can hold a lock (the wire-layer resolution does the
 scoping); R9 applies to the control-plane packages — files under
-``_private/`` or ``serve/``.
+``_private/``, ``serve/`` or ``mesh/``, plus the provisioning client
+files ``autoscaler.py`` / ``cloud_rest.py`` (PR 15: heal-loop error
+chains must attribute, a blank timeout is an unattributable MTTR).
 """
 
 from __future__ import annotations
@@ -591,7 +593,16 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
         _check_r4(fn_nodes, path, aliases, findings)
     if "R5" in enabled:
         _check_r5(tree, path, func_of, findings)
-    if "R9" in enabled and (in_private or "serve" in posix.split("/")):
+    # R9 scope (PR 15 widened): control-plane packages (_private/,
+    # serve/) plus the elastic compute plane — mesh/ and the
+    # provisioning client files, whose error chains feed heal-loop
+    # attribution (a blank timeout there is an unattributable MTTR).
+    in_r9_scope = (
+        in_private
+        or {"serve", "mesh"} & set(posix.split("/"))
+        or base in ("autoscaler.py", "cloud_rest.py")
+    )
+    if "R9" in enabled and in_r9_scope:
         _check_r9(tree, path, func_of, findings)
     if fis is not None:
         for fi in fis:
