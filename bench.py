@@ -482,6 +482,19 @@ def main():
                 )
             except Exception as e:
                 micro["gcs_plane"] = {"error": str(e)[:160]}
+            # control-plane failover (r16): SIGKILL the primary GCS
+            # under sustained mutations -> warm-standby promotion MTTR,
+            # acked-mutations lost (hard-gated zero), split-brain
+            # fencing of a resurrected old primary. Subprocess-isolated.
+            from ray_tpu._private.ray_perf import run_gcs_failover_bench
+
+            try:
+                micro["gcs_failover"] = run_gcs_failover_bench()
+                micro["gcs_failover_mttr_s"] = (
+                    micro["gcs_failover"]["gcs_failover_mttr_s"]
+                )
+            except Exception as e:
+                micro["gcs_failover"] = {"error": str(e)[:160]}
             # compute plane (r10): gang spin-up + lockstep compiled
             # steps/s of a 2-host CPU MeshGroup (STRICT_SPREAD
             # placement, TCP rendezvous, pjit dispatch). Subprocess-
@@ -639,6 +652,40 @@ def main():
                     "metric": "gcs_group_commit_speedup",
                     "value": gp.get("group_commit_speedup"),
                     "floor": ">= 3.0",
+                })
+        gf = micro.get("gcs_failover") or {}
+        if "error" not in gf and gf:
+            # bounded-MTTR failover is the contract: grace window (1s
+            # configured) + promotion + client endpoint cycling must
+            # land the first served RPC well inside this ceiling
+            if (gf.get("gcs_failover_mttr_s") or 1e9) > 10.0:
+                violations.append({
+                    "metric": "gcs_failover_mttr_s",
+                    "value": gf.get("gcs_failover_mttr_s"),
+                    "floor": "<= 10",
+                })
+            # HARD gate — zero lost acks: with ship acks on, "durable"
+            # means standby-applied, so a SIGKILL can never lose a
+            # mutation a client saw acknowledged
+            if (gf.get("acks_lost") if gf.get("acks_lost") is not None
+                    else 99) != 0:
+                violations.append({
+                    "metric": "gcs_failover_acks_lost",
+                    "value": gf.get("acks_lost"), "floor": "== 0",
+                })
+            # the kill must land under real concurrent load, and the
+            # resurrected old primary must fence itself out (exit 3)
+            if (gf.get("load_mutations_per_s") or 0.0) < 500.0:
+                violations.append({
+                    "metric": "gcs_failover_load_mutations_per_s",
+                    "value": gf.get("load_mutations_per_s"),
+                    "floor": ">= 500",
+                })
+            if (gf.get("old_primary_fenced") or 0) != 1:
+                violations.append({
+                    "metric": "gcs_failover_old_primary_fenced",
+                    "value": gf.get("old_primary_fenced"),
+                    "floor": "== 1",
                 })
         # sync actor RTT: recorded AND statically bounded (the real
         # gate is the actor_calls_per_s ratchet; this ceiling catches

@@ -117,6 +117,14 @@ class ChaosPlane:
             # frames TO the target (link matches) and FROM it (role matches)
             self.window_rules.append(ChaosRule(dict(w, link=tgt)))
             self.window_rules.append(ChaosRule(dict(w, role=tgt)))
+        # Mutes: one-sided windowed silence — drop every frame matching
+        # the given role/link substrings inside [start, end). Unlike a
+        # blackout this does NOT also drop frames *to* the target, so
+        # "partition the primary GCS" (role "gcs" muted) leaves the
+        # promoted standby's links — whose names also contain "gcs" on
+        # the client side — untouched.
+        for m in spec.get("mutes") or []:
+            self.window_rules.append(ChaosRule(m))
         self.stats = collections.Counter()
 
     # ---------------- matching ----------------
@@ -206,6 +214,7 @@ def make_spec(
     rules: Optional[List[Dict]] = None,
     partitions: Optional[List[Dict]] = None,
     blackouts: Optional[List[Dict]] = None,
+    mutes: Optional[List[Dict]] = None,
     epoch: Optional[float] = None,
 ) -> Dict:
     """Build a chaos spec dict. ``rules`` overrides the single-rule
@@ -221,7 +230,18 @@ def make_spec(
         "rules": rules,
         "partitions": partitions or [],
         "blackouts": blackouts or [],
+        "mutes": mutes or [],
     }
+
+
+def gcs_partition_mutes(at: float, duration: float) -> List[Dict]:
+    """Failover chaos schedule: silence the primary GCS's outbound for
+    ``[at, at+duration)`` (its role is exactly "gcs"; the standby runs
+    as role "standby" precisely so this window cannot touch it). The
+    primary keeps RECEIVING — the nastiest partition shape: clients and
+    the standby see an open TCP connection that stops answering, so
+    detection must come from probe/call timeouts, never conn close."""
+    return [{"role": "gcs", "link": "*", "start": at, "end": at + duration}]
 
 
 def install(spec: Dict, role: str = "") -> "ChaosPlane":

@@ -166,6 +166,9 @@ class ConduitConnection:
         self._done_flush_armed = False  # deferred starvation-bound flush
         # chaos-plane link identity (see rpc.Connection.chaos_peer)
         self.chaos_peer = ""
+        # last GCS epoch stamped on a reply from the peer (see
+        # rpc.Connection.peer_epoch — the client-side fencing input)
+        self.peer_epoch: Optional[int] = None
         self._chaos_seq = itertools.count()  # thread-safe enough (GIL)
 
     # ---- outbound (any thread) ----
@@ -182,10 +185,12 @@ class ConduitConnection:
         )
         return pl.decide(link, next(self._chaos_seq))
 
-    def send_frame(self, kind, seqno, method, data, rid=None):
+    def send_frame(self, kind, seqno, method, data, rid=None, epoch=None):
         msg = [kind, seqno, method, data]
-        if rid is not None:
+        if rid is not None or epoch is not None:
             msg.append(rid)
+        if epoch is not None:
+            msg.append(epoch)
         body = msgpack.packb(msg, use_bin_type=True)
         decision = self._chaos_decision()
         if decision is not None:
@@ -387,14 +392,15 @@ class ConduitConnection:
             pass
 
     # ---- rpc.Connection surface ----
-    async def call_async(self, method, data, timeout=None, rid=None):
+    async def call_async(self, method, data, timeout=None, rid=None,
+                         epoch=None):
         seqno = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
         try:
             if self._closed:
                 raise rpc.SendError(f"connection {self.name} closed")
-            self.send_frame(rpc._REQUEST, seqno, method, data, rid)
+            self.send_frame(rpc._REQUEST, seqno, method, data, rid, epoch)
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
@@ -458,7 +464,13 @@ class ConduitConnection:
         msg = msgpack.unpackb(payload, raw=False)
         kind, seqno, method, data = msg[0], msg[1], msg[2], msg[3]
         rid = msg[4] if len(msg) > 4 else None
+        epoch = msg[5] if len(msg) > 5 else None
         if kind in (rpc._REPLY, rpc._ERROR):
+            if epoch is not None:
+                # reaper thread, before the resolving callback is even
+                # scheduled — a caller reading peer_epoch after its
+                # future resolves always sees this reply's stamp
+                self.peer_epoch = epoch
             self.loop.call_soon_threadsafe(self._resolve, kind, seqno, data)
             return
         fast = self.fast_dispatch
@@ -490,7 +502,7 @@ class ConduitConnection:
                 self.loop.call_soon_threadsafe(self._drain_sync_notifies)
                 return
         self.loop.call_soon_threadsafe(
-            self._spawn_handler, kind, seqno, method, data, rid
+            self._spawn_handler, kind, seqno, method, data, rid, epoch
         )
 
     def _drain_sync_notifies(self):
@@ -570,13 +582,16 @@ class ConduitConnection:
             else:
                 fut.set_exception(rpc.RpcError(data))
 
-    def _spawn_handler(self, kind, seqno, method, data, rid=None):
-        self.loop.create_task(self._handle(kind, seqno, method, data, rid))
+    def _spawn_handler(self, kind, seqno, method, data, rid=None,
+                       epoch=None):
+        self.loop.create_task(
+            self._handle(kind, seqno, method, data, rid, epoch))
 
-    async def _handle(self, kind, seqno, method, data, rid=None):
+    async def _handle(self, kind, seqno, method, data, rid=None,
+                      epoch=None):
         t0 = time.monotonic()
         out_kind, payload = await rpc.run_idempotent(
-            rid, lambda: self.handler(self, method, data)
+            rid, lambda: self.handler(self, method, data), epoch=epoch
         )
         if out_kind == rpc._REPLY:
             rpc.method_stats().record(
@@ -594,7 +609,11 @@ class ConduitConnection:
                     pass  # send_raw_frame fired on_sent before raising
                 return
             try:
-                self.send_frame(out_kind, seqno, method, payload)
+                self.send_frame(
+                    out_kind, seqno, method, payload,
+                    epoch=None if rpc._EPOCH_PROVIDER is None
+                    else rpc._EPOCH_PROVIDER(),
+                )
             except Exception:
                 pass
 

@@ -250,6 +250,25 @@ _d("gcs_journal_flush_interval_s", float, 0.0)
 # after a journal-restored GCS boots, how long raylets get to re-register
 # and reclaim their live actors before unclaimed ones are re-placed
 _d("gcs_actor_recovery_grace_s", float, 10.0)
+# --- GCS warm standby (r16) ---
+# run a standby GCS process that live-tails the primary's group-commit
+# journal and promotes itself (epoch+1, fenced) when the primary stays
+# unreachable past the grace. Implies file-style persistence for the
+# control plane (the primary journals even under the memory backend so
+# there is a stream to ship).
+_d("gcs_standby", bool, False)
+# durable-at-ack tier while a standby is subscribed: a mutation's reply
+# additionally waits for the standby to APPLY the covering journal
+# batch, so a primary SIGKILL can never lose an acked mutation (off =
+# primary-disk durability only; async ship can lose the last in-flight
+# batch at failover). Degrades to primary-disk — never blocks the
+# control plane — when the standby misses the ack timeout.
+_d("gcs_standby_ack", bool, True)
+_d("gcs_standby_ack_timeout_s", float, 2.0)
+# how long the standby keeps retrying the primary before promoting: a
+# plain restart (supervisor respawn) inside this window wins over a
+# failover. Also the primary->peer probe cadence bound for fencing.
+_d("gcs_failover_grace_s", float, 2.0)
 # --- tpu ---
 _d("tpu_mesh_bootstrap_timeout_s", float, 300.0)
 # --- mesh groups (gang-scheduled multi-host pjit) ---

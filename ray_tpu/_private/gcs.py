@@ -238,6 +238,16 @@ class GcsJournal:
         self.buffer(rec)
         self.flush_buffered()
 
+    def append_frames(self, frames: List[bytes]) -> int:
+        """Append already-framed records verbatim (one write+flush): the
+        standby's journal write side — shipped batches arrive as the
+        primary's raw frames and must land byte-identical, so a
+        promotion's replay sees exactly the primary's log."""
+        for fb in frames:
+            self._buf += fb
+        self._buf_records += len(frames)
+        return self.flush_buffered()
+
     def rotate(self) -> str:
         """Move the current log aside (journal.old) and start fresh; the
         caller snapshots the tables in the same event-loop tick, so the
@@ -327,9 +337,114 @@ class GcsJournal:
                     return
 
 
+class GcsJournalTailer:
+    """Record-exact incremental reader of a LIVE journal that the writer
+    may rotate (``rotate()`` os.replace's current → ``.old``) under it
+    at any moment — the journal-shipping read side (r16).
+
+    The rotation race this closes: a naive tailer holding an offset into
+    the journal PATH loses the rotated-out tail (the path suddenly names
+    an empty file) or re-reads from 0. This tailer holds the open FD:
+    POSIX keeps the renamed segment's bytes readable through it, so the
+    handoff drains the old segment to EOF — the writer never appends to
+    a rotated-out file again — and only then reopens the path at offset
+    0. The switch therefore lands at an exact record boundary: no frame
+    is split across segments, none is skipped, none repeats.
+
+    A trailing partial frame (the tailer racing the writer's in-flight
+    ``write()``) is left unconsumed — the next call re-reads it whole.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._ino = None
+        self.records = 0    # total records yielded since construction
+        self.rotations = 0  # segment handoffs observed
+        # open EAGERLY: the fd must be pinned to the current segment
+        # BEFORE any rotation can happen, or a rotate-before-first-read
+        # would silently skip the rotated-out records (the lazy open
+        # would land on the fresh post-rotation file)
+        self._open_current()
+
+    def _open_current(self) -> bool:
+        try:
+            self._f = open(self.path, "rb")
+        except FileNotFoundError:
+            self._f = None
+            return False
+        self._ino = os.fstat(self._f.fileno()).st_ino
+        return True
+
+    def _drain(self, out: List[bytes]):
+        """Whole frames from the held fd's position to EOF; a partial
+        tail rewinds so the next drain re-reads it complete."""
+        f = self._f
+        while True:
+            start = f.tell()
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                f.seek(start)
+                return
+            n = int.from_bytes(hdr, "big")
+            body = f.read(n)
+            if len(body) < n:
+                f.seek(start)
+                return
+            out.append(hdr + body)
+
+    def read_new(self) -> List[bytes]:
+        """Every record frame (raw ``[u32 len][msgpack]`` bytes) that
+        became readable since the last call, in append order, each
+        exactly once — across any number of rotations."""
+        out: List[bytes] = []
+        for _ in range(64):  # bounds a pathological rotate storm
+            if self._f is None and not self._open_current():
+                break
+            st = os.fstat(self._f.fileno())
+            if st.st_size < self._f.tell():
+                # truncated in place under us (writer reset()): the
+                # whole file is new content
+                self._f.seek(0)
+            self._drain(out)
+            try:
+                cur_ino = os.stat(self.path).st_ino
+            except FileNotFoundError:
+                break  # current unlinked (shutdown); nothing newer
+            if cur_ino == self._ino:
+                break  # same segment, drained to its frame tail
+            # rotated under us: the writer flushed nothing more into the
+            # old segment after the rename, so one final drain of the
+            # held fd empties it — then hand off to the new current
+            self._drain(out)
+            self._f.close()
+            self._f = None
+            self.rotations += 1
+        self.records += len(out)
+        return out
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
 class GcsServer:
-    def __init__(self, sock_path: str, storage_path: Optional[str] = None):
+    def __init__(self, sock_path: str, storage_path: Optional[str] = None,
+                 peer_addrs: Optional[List[str]] = None):
         self.sock_path = sock_path
+        # GCS epoch (r16 failover fencing): bumped by exactly one on
+        # every standby promotion, persisted in the snapshot and as an
+        # "epoch" journal record so it survives any crash. Every reply
+        # this server sends is stamped with it (rpc.set_epoch_provider)
+        # and requests minted under a lower epoch are refused typed.
+        self.epoch = 1
+        # other GCS endpoints (the standby, or after promotion the old
+        # primary): probed by _standby_watch_loop for split-brain
+        # fencing whenever no standby is subscribed
+        self.peer_addrs = [a for a in (peer_addrs or []) if a]
+        self._fenced = asyncio.Event()
+        self._fence_task: Optional[asyncio.Task] = None
         # file-backed table persistence (parity: reference Redis GCS FT,
         # gcs_table_storage.h:252 / redis_store_client.h:33): KV + jobs
         # reload across GCS restarts; runtime state (nodes, actors) is
@@ -391,15 +506,33 @@ class GcsServer:
         self._journal_flush_fut: Optional[asyncio.Future] = None
         self._journal_flush_handle = None
         self._journal_flushing = False
+        # journal shipping (r16): subscribed standby conns -> stats,
+        # the tailer feeding them, the buffered-record counter that
+        # numbers the stream, and the ack-gating waiters (handlers
+        # blocked until the standby APPLIES their covering batch)
+        self._standby_conns: Dict[rpc.Connection, Dict] = {}
+        self._ship_tailer: Optional[GcsJournalTailer] = None
+        self._journal_seq = 0     # records buffered since journal reset
+        self._standby_acked = 0   # highest standby-applied seq
+        self._ship_waiters: List[Tuple[int, asyncio.Future]] = []
 
     # ---------------- lifecycle ----------------
-    async def start(self):
-        self._load_storage()
-        if self.storage_path:
-            self._journal_w = GcsJournal(
-                self.storage_path + ".journal",
-                fsync=GLOBAL_CONFIG.gcs_journal_fsync,
-            )
+    async def start(self, preloaded: bool = False):
+        """``preloaded=True`` is the standby-promotion entry: the tables
+        and ``_journal_w`` were populated live by the ship stream (and
+        ``epoch`` already bumped + journaled), so storage load is
+        skipped — everything else (startup compaction, recovery marks,
+        bind, loops) runs exactly like a restart."""
+        if not preloaded:
+            self._load_storage()
+            if self.storage_path:
+                self._journal_w = GcsJournal(
+                    self.storage_path + ".journal",
+                    fsync=GLOBAL_CONFIG.gcs_journal_fsync,
+                )
+        else:
+            self._derive_restore_state()
+        if self._journal_w is not None:
             # startup compaction: everything just restored goes into one
             # fresh snapshot, then both journals reset — replay stays O(one
             # snapshot interval), not O(uptime)
@@ -410,11 +543,22 @@ class GcsServer:
                 await asyncio.to_thread(self._startup_compact)
             except Exception:
                 logger.exception("GCS startup snapshot compaction failed")
+            # ship read side: the tailer follows the freshly-reset
+            # journal; seq numbering restarts with it
+            self._journal_seq = 0
+            self._standby_acked = 0
+            self._ship_tailer = GcsJournalTailer(
+                self.storage_path + ".journal")
+        # every reply from this process now carries the epoch; stale-
+        # epoch requests get the typed refusal (rpc.run_idempotent)
+        rpc.set_epoch_provider(lambda: self.epoch)
         await self.server.start_async()
         loop = asyncio.get_running_loop()
         self._health_task = loop.create_task(self._health_loop())
         if self.storage_path:
             self._persist_task = loop.create_task(self._persist_loop())
+        if self.peer_addrs:
+            self._fence_task = loop.create_task(self._standby_watch_loop())
         if self._recovering or any(
             pg.state in (PG_PENDING, PG_RESCHEDULING)
             for pg in self.placement_groups.values()
@@ -425,6 +569,11 @@ class GcsServer:
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._fence_task is not None and not self._fenced.is_set():
+            self._fence_task.cancel()
+        self._drop_standbys()
+        if self._ship_tailer is not None:
+            self._ship_tailer.close()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
             if self.storage_path:
@@ -492,6 +641,7 @@ class GcsServer:
             self.kv = snap.get("kv", {})
             self.jobs = snap.get("jobs", {})
             self.autoscaler_intents = dict(snap.get("intents") or {})
+            self.epoch = int(snap.get("epoch") or 1)
             for d in snap.get("actors") or []:
                 rec = ActorRecord.from_state(d)
                 self.actors[rec.actor_id] = rec
@@ -513,7 +663,13 @@ class GcsServer:
                                      rec[:1])
         if snap is None and not replayed:
             return
-        # named-actor index + recovery marks derive from the records
+        self._derive_restore_state(replayed)
+
+    def _derive_restore_state(self, replayed: int = 0):
+        """Post-restore reconciliation, shared by the restart path and a
+        standby promotion (whose tables arrived via the ship stream):
+        the named-actor index and the raylet-reclaim recovery marks
+        derive from the restored records."""
         for rec in self.actors.values():
             if rec.name and rec.state != DEAD:
                 self.named_actors.setdefault(rec.name, rec.actor_id)
@@ -524,9 +680,9 @@ class GcsServer:
                 self._recovering.add(rec.actor_id)
         logger.info(
             "restored GCS tables (%d kv keys, %d jobs, %d actors, %d pgs; "
-            "%d journal records replayed)",
+            "%d journal records replayed; epoch %d)",
             len(self.kv), len(self.jobs), len(self.actors),
-            len(self.placement_groups), replayed,
+            len(self.placement_groups), replayed, self.epoch,
         )
 
     def _journal_apply(self, rec: List):
@@ -555,6 +711,10 @@ class GcsServer:
                 self.autoscaler_intents.pop(key, None)
             else:
                 self.autoscaler_intents[key] = dict(value)
+        elif op == "epoch":
+            # promotion fence record: epochs only move forward (a
+            # shipped/replayed stale bump must never regress a newer one)
+            self.epoch = max(self.epoch, int(rec[1]))
 
     # -- journal write side (no-ops on the memory backend) --
     def _journal(self, rec: List) -> Optional[asyncio.Future]:
@@ -575,6 +735,7 @@ class GcsServer:
             # no loop (unit tests / teardown): per-record semantics
             try:
                 j.append(rec)
+                self._journal_seq += 1
             except Exception:
                 logger.exception(
                     "GCS journal append failed; journaling disabled")
@@ -588,10 +749,16 @@ class GcsServer:
             self._journal_w = None
             self._mark_dirty()
             return None
+        self._journal_seq += 1
         self._mark_dirty()
         fut = self._journal_flush_fut
         if fut is None or fut.done():
             fut = self._journal_flush_fut = loop.create_future()
+        # stream position of the LAST record the covering flush includes:
+        # _journal_wait's standby ack gate waits for the standby to apply
+        # through here (conservative for earlier records in the batch —
+        # the whole batch ships as one notify anyway)
+        fut._gcs_seq = self._journal_seq
         if depth >= max(1, int(GLOBAL_CONFIG.gcs_journal_batch_max)):
             self._flush_journal_now()
         elif self._journal_flush_handle is None and not self._journal_flushing:
@@ -634,6 +801,7 @@ class GcsServer:
                 self._journal_w = None
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            self._ship_pump()
             return
         loop = asyncio.get_running_loop()
         self._journal_flushing = True
@@ -649,6 +817,8 @@ class GcsServer:
                 logger.error("GCS journal flush failed; journaling "
                              "disabled: %r", task.exception())
                 self._journal_w = None
+            else:
+                self._ship_pump()
             if fut is not None and not fut.done():
                 fut.set_result(True)
             if self._journal_w is not None and self._journal_w.buffered:
@@ -669,9 +839,20 @@ class GcsServer:
 
     async def _journal_wait(self, fut: Optional[asyncio.Future]):
         """Durable-at-ack barrier: await the flush covering a just-
-        buffered record (no-op on the memory backend)."""
-        if fut is not None:
-            await fut
+        buffered record (no-op on the memory backend). With a standby
+        subscribed and ``gcs_standby_ack`` on, "durable" additionally
+        means standby-APPLIED: the ack only goes out once the covering
+        batch landed on the standby, so a primary SIGKILL immediately
+        after the ack can never lose the mutation across the failover.
+        Degrades (never blocks the control plane) when the standby
+        misses the ack window."""
+        if fut is None:
+            return
+        await fut
+        seq = getattr(fut, "_gcs_seq", 0)
+        if (seq and self._standby_conns
+                and GLOBAL_CONFIG.gcs_standby_ack):
+            await self._await_standby_ack(seq)
 
     def _journal_actor(self, rec: "ActorRecord") -> Optional[asyncio.Future]:
         if self._journal_w is not None:
@@ -682,6 +863,172 @@ class GcsServer:
         if self._journal_w is not None:
             return self._journal(["pg", rec.to_state()])
         return None
+
+    # ---------------- journal shipping + failover fencing (r16) ------
+
+    def _ship_pump(self):
+        """Stream newly-flushed journal frames to subscribed standbys;
+        runs (on the loop) after EVERY flush, even with no subscriber —
+        the tailer's record counter must stay aligned with the journal
+        or a later subscriber's stream would be misnumbered. The tailer
+        hands segments off at exact record boundaries across rotations,
+        so a shipped batch is always whole records."""
+        t = self._ship_tailer
+        if t is None:
+            return
+        try:
+            frames = t.read_new()
+        except Exception:
+            logger.exception("journal ship tailer failed; shipping "
+                             "disabled until restart")
+            self._ship_tailer = None
+            self._drop_standbys()
+            return
+        if not frames or not self._standby_conns:
+            return
+        batch = {"epoch": self.epoch, "seq": t.records - len(frames),
+                 "recs": frames}
+        loop = asyncio.get_running_loop()
+        for conn in list(self._standby_conns):
+            loop.create_task(self._ship_send(conn, batch))
+
+    async def _ship_send(self, conn: rpc.Connection, batch: Dict):
+        try:
+            await conn.notify_async("journal_batch", batch)
+        except Exception:
+            conn._do_close()  # close callback runs _on_standby_gone
+
+    async def rpc_journal_sync(self, conn, data):
+        """Standby bootstrap + ship subscription: registers ``conn`` as
+        a journal-stream subscriber and returns the full table state
+        with its covering stream seq — both in THIS event-loop tick, so
+        snapshot, seq and stream are mutually consistent (no flush can
+        land between the copy and the subscribe). Shipped records with
+        index < the returned seq are duplicates the standby skips."""
+        if self._journal_w is None or self._ship_tailer is None:
+            return {"ok": False,
+                    "error": "journal shipping unavailable (no journal)"}
+        conn.chaos_peer = "standby"
+        self._standby_conns[conn] = {"acked": 0, "since": time.time()}
+        conn.add_close_callback(self._on_standby_gone)
+        logger.info("journal ship subscriber attached (%d standby%s)",
+                    len(self._standby_conns),
+                    "" if len(self._standby_conns) == 1 else "s")
+        return {
+            "ok": True,
+            "epoch": self.epoch,
+            "seq": self._journal_seq,
+            "snap": self._tables_state(),
+        }
+
+    async def rpc_journal_ack(self, conn, data):
+        """Standby apply-progress: resolves the durable-at-ack waiters
+        whose records the standby has now applied."""
+        ent = self._standby_conns.get(conn)
+        seq = int(data.get("seq") or 0)
+        if ent is not None:
+            ent["acked"] = seq
+        if seq > self._standby_acked:
+            self._standby_acked = seq
+            self._resolve_ship_waiters(seq)
+        return True
+
+    async def rpc_gcs_probe(self, conn, data):
+        """Peer/diagnostic probe: epoch + role, no registration needed
+        (the split-brain fence and the standby's liveness ping ride
+        this)."""
+        return {"epoch": self.epoch, "role": "primary",
+                "fenced": self._fenced.is_set()}
+
+    def _on_standby_gone(self, conn):
+        if self._standby_conns.pop(conn, None) is None:
+            return
+        logger.warning("journal ship subscriber lost (%d remain)",
+                       len(self._standby_conns))
+        if not self._standby_conns:
+            # no applier left: durable-at-ack degrades to primary-disk;
+            # blocked handlers must not each wait out the full timeout
+            self._resolve_ship_waiters(None)
+
+    def _drop_standbys(self):
+        for conn in list(self._standby_conns):
+            try:
+                conn._do_close()
+            except Exception:
+                pass
+        self._standby_conns.clear()
+        self._resolve_ship_waiters(None)
+
+    def _resolve_ship_waiters(self, upto: Optional[int]):
+        """Release ack-gate waiters with seq <= ``upto`` (None = all)."""
+        keep: List[Tuple[int, asyncio.Future]] = []
+        for seq, fut in self._ship_waiters:
+            if upto is None or seq <= upto:
+                if not fut.done():
+                    fut.set_result(True)
+            else:
+                keep.append((seq, fut))
+        self._ship_waiters = keep
+
+    async def _await_standby_ack(self, seq: int):
+        if seq <= self._standby_acked or not self._standby_conns:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._ship_waiters.append((seq, fut))
+        window = max(0.1, GLOBAL_CONFIG.gcs_standby_ack_timeout_s)
+        try:
+            await asyncio.wait_for(fut, window)
+        except asyncio.TimeoutError:
+            # availability over the stronger tier: a wedged standby must
+            # not stall every control-plane ack — drop it (it will
+            # resync when healthy) and serve at primary-disk durability
+            logger.warning(
+                "standby apply-ack for seq %d missed the %.1fs window; "
+                "degrading durable-at-ack to primary-disk and dropping "
+                "the standby subscription", seq, window)
+            self._drop_standbys()
+
+    async def _standby_watch_loop(self):
+        """Split-brain guard on any GCS started with peer endpoints:
+        while no standby is subscribed (a subscribed standby cannot have
+        promoted), probe the peers — one serving at a HIGHER epoch means
+        this instance was failed over while dead or partitioned. Fence:
+        stop serving (the daemon exits with code 3) instead of feeding
+        stale acks to clients that haven't learned the new epoch yet.
+        Clients that HAVE seen the new epoch reject this instance on
+        their own (reply-epoch regression); this loop closes the window
+        for the rest."""
+        period = max(0.5, GLOBAL_CONFIG.gcs_failover_grace_s / 2.0)
+        while not self._fenced.is_set():
+            await asyncio.sleep(period)
+            if self._standby_conns:
+                continue
+            for addr in self.peer_addrs:
+                conn = None
+                try:
+                    conn = await rpc.connect_async(
+                        addr, timeout=1.0, name="gcs->peer")
+                    r = await conn.call_async("gcs_probe", None,
+                                              timeout=2.0)
+                except Exception:
+                    continue  # peer down/unreachable: nothing to fence on
+                finally:
+                    if conn is not None:
+                        conn._do_close()
+                ep = int(r.get("epoch") or 0) if isinstance(r, dict) else 0
+                if ep > self.epoch:
+                    self._fence(ep)
+                    return
+
+    def _fence(self, peer_epoch: int):
+        if self._fenced.is_set():
+            return
+        logger.critical(
+            "GCS epoch-fenced: a peer serves at epoch %d > ours %d "
+            "(promoted while this instance was dead or partitioned); "
+            "ceasing to serve", peer_epoch, self.epoch)
+        self._fenced.set()
+        asyncio.get_running_loop().create_task(self.stop())
 
     async def _recover_after_grace(self):
         """Journal-restored runtime state reconciliation: give raylets one
@@ -733,6 +1080,14 @@ class GcsServer:
                     self._journal_rotated_old = self._journal_w.rotate()
                 except Exception:
                     logger.exception("journal rotation failed")
+        return self._tables_state()
+
+    def _tables_state(self) -> Dict:
+        """Pure copy of the journal-backed tables (+ epoch) — the
+        snapshot payload, also the ``journal_sync`` bootstrap a standby
+        loads. No side effects: callers that need the rotation/dirty
+        bookkeeping use :meth:`_snapshot`. Runs on the event loop, so
+        the copy is a consistent point-in-time state."""
         return {
             "kv": dict(self.kv),
             "jobs": dict(self.jobs),
@@ -740,6 +1095,7 @@ class GcsServer:
             "pgs": [r.to_state() for r in self.placement_groups.values()],
             "intents": {k: dict(v)
                         for k, v in self.autoscaler_intents.items()},
+            "epoch": self.epoch,
         }
 
     def _write_snapshot(self, blob: bytes):
@@ -899,7 +1255,11 @@ class GcsServer:
         self._raylet_clients[info.node_id] = conn
         logger.info("node registered: %s", info.node_id.hex()[:12])
         self._publish("nodes", [info.to_wire()])
-        return {"node_id": info.node_id, "config": GLOBAL_CONFIG.dump()}
+        # epoch in the registration reply: the raylet's fencing floor —
+        # it refuses to re-register against a GCS whose epoch regresses
+        # (a resurrected pre-failover primary)
+        return {"node_id": info.node_id, "config": GLOBAL_CONFIG.dump(),
+                "epoch": self.epoch}
 
     def _make_node_close_handler(self, node_id: bytes):
         def on_close(conn):
@@ -1833,6 +2193,13 @@ class GcsServer:
                 self._journal_w.buffered if self._journal_w else None
             ),
             "recovering_actors": len(self._recovering),
+            "epoch": self.epoch,
+            "standbys": len(self._standby_conns),
+            "standby_acked_seq": self._standby_acked,
+            "journal_seq": self._journal_seq,
+            "shipped_records": (
+                self._ship_tailer.records if self._ship_tailer else None
+            ),
             "method_stats": rpc.method_stats().snapshot(),
         }
 
@@ -1850,6 +2217,10 @@ def main():
     p.add_argument("--sock")
     p.add_argument("--config", default="")
     p.add_argument("--storage", default="")
+    # comma-separated peer GCS endpoints (the warm standby): probed for
+    # split-brain fencing — a peer at a higher epoch means THIS daemon
+    # was failed over and must stop serving
+    p.add_argument("--peers", default="")
     args = p.parse_args()
     logging.basicConfig(
         level=logging.INFO,
@@ -1861,12 +2232,20 @@ def main():
 
         GLOBAL_CONFIG.load(json.loads(args.config))
 
-    async def run():
-        gcs = GcsServer(args.sock, storage_path=args.storage or None)
+    async def run() -> int:
+        gcs = GcsServer(
+            args.sock, storage_path=args.storage or None,
+            peer_addrs=[a.strip() for a in args.peers.split(",")
+                        if a.strip()],
+        )
         await gcs.start()
-        await asyncio.Event().wait()  # serve forever
+        # serve until epoch-fenced (never, without a promoted peer);
+        # exit code 3 tells the supervisor this was a split-brain
+        # rejection, not a crash — do not blindly respawn
+        await gcs._fenced.wait()
+        return 3
 
-    asyncio.run(run())
+    sys.exit(asyncio.run(run()))
 
 
 if __name__ == "__main__":

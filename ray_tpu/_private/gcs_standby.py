@@ -1,0 +1,377 @@
+"""GCS warm standby (r16): live journal tailing + bounded-MTTR promotion.
+
+A second GCS process that holds a WARM, unstarted :class:`GcsServer`:
+it bootstraps from the primary's ``journal_sync`` snapshot, then applies
+every shipped group-commit batch through the same ``_journal_apply``
+path a restart replay uses — so at any instant its tables are exactly
+"primary tables as of the last acked batch", and its own on-disk journal
+is byte-identical to the primary's (raw frames, re-flushed locally)
+from the sync point on.
+
+Failover FSM (states are exclusive; the process runs exactly one):
+
+    SYNCING   -- connect to the primary, journal_sync, load snapshot
+    FOLLOWING -- apply shipped batches, ack applied seq, ping liveness
+    GRACE     -- primary unreachable: retry for gcs_failover_grace_s
+                 (a plain restart inside the window wins over failover)
+    PROMOTING -- journal the epoch bump (durable FIRST), then
+                 GcsServer.start(preloaded=True): startup compaction,
+                 recovery marks, bind the serving socket, health loops
+    SERVING   -- a normal primary (ships to a future standby, probes the
+                 old primary's endpoint and fences it if it resurrects)
+
+Split-brain safety: the standby does NOT bind its serving socket until
+PROMOTING completes, so clients cycling the multi-address endpoint list
+can only ever reach one serving GCS per epoch; the epoch bump is
+journaled before the first bind, so a crash mid-promotion can never
+come back serving the old epoch. The resurrected old primary fences
+itself via the peer probe (exit code 3) and every client rejects its
+regressed reply epoch meanwhile.
+
+Heartbeat-death grace is structural: the promoted server starts with an
+EMPTY node table (node liveness is runtime state, never journaled), so
+it cannot declare false node deaths during the failover window — each
+raylet's first heartbeat gets ``{"reregister": True}`` and runs the full
+PR 1 re-registration (register + resubscribe + live-actor reclaim)
+inside ``gcs_actor_recovery_grace_s``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.gcs import (
+    ActorRecord,
+    GcsJournal,
+    GcsServer,
+    PgRecord,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class GcsStandby:
+    def __init__(self, sock_addr: str, primary_addrs: str,
+                 storage_path: str):
+        self.sock_addr = sock_addr
+        # callers may pass the cluster's full endpoint list (convenient
+        # when re-arming after a failover: follow whoever serves) — our
+        # own serving address is never a primary to dial
+        self.primary_addrs = [a.strip() for a in primary_addrs.split(",")
+                              if a.strip() and a.strip() != sock_addr]
+        self.storage_path = storage_path
+        # warm server: constructed (tables, handler plumbing) but NOT
+        # started — the serving socket binds only at promotion. Its
+        # peer list is the primary's endpoints, so after promotion its
+        # own watch loop fences a resurrected old primary.
+        self.server = GcsServer(sock_addr, storage_path=storage_path,
+                                peer_addrs=self.primary_addrs)
+        # the standby's own journal: shipped frames land here verbatim
+        # before they are applied, so a standby crash (or the promotion
+        # handoff) replays exactly the primary's log from the sync point
+        self.journal = GcsJournal(storage_path + ".journal",
+                                  fsync=GLOBAL_CONFIG.gcs_journal_fsync)
+        self.server._journal_w = self.journal
+        self.conn: Optional[rpc.Connection] = None
+        self.primary_epoch = 0
+        self.applied_seq = 0      # primary-stream records applied
+        self.batches_applied = 0
+        self.resyncs = 0
+        self._synced = False
+        self._records_since_snap = 0
+        self._compacting = False
+        self.promoted = False
+
+    # ---------------- follow the primary ----------------
+
+    async def run(self):
+        """SYNCING/FOLLOWING/GRACE until the primary stays gone past the
+        grace window, then promote. Returns once serving."""
+        grace = max(0.2, GLOBAL_CONFIG.gcs_failover_grace_s)
+        # initial sync gets a patient budget: the primary may still be
+        # booting when the supervisor spawns both daemons
+        await self._sync(connect_timeout=30.0)
+        while True:
+            await self._follow()
+            lost_at = time.monotonic()
+            logger.warning(
+                "primary GCS unreachable; %.1fs grace before promotion",
+                grace)
+            resynced = False
+            while time.monotonic() - lost_at < grace:
+                try:
+                    await self._sync(connect_timeout=max(
+                        0.2, grace - (time.monotonic() - lost_at)))
+                    resynced = True
+                    break
+                except Exception as e:
+                    logger.info("primary still down (%s)", e)
+                    await asyncio.sleep(0.1)
+            if resynced:
+                continue  # a restart won inside the window: keep following
+            await self._promote()
+            return
+
+    async def _sync(self, connect_timeout: float):
+        """SYNCING: fresh connection + full table bootstrap, in one RPC.
+        Cycles the primary endpoint list (after a failback the old
+        primary may serve at a different list position)."""
+        last: Optional[Exception] = None
+        # split the budget across endpoints so a dead-but-present first
+        # address cannot eat the whole grace window
+        per_addr = max(0.1, connect_timeout / max(1, len(self.primary_addrs)))
+        for addr in self.primary_addrs:
+            try:
+                conn = await rpc.connect_async(
+                    addr, rpc.handler_table(self),
+                    timeout=per_addr, name="standby->gcs")
+            except Exception as e:
+                last = e
+                continue
+            try:
+                # bounded by the per-endpoint budget: a PARTITIONED (not
+                # dead) primary accepts the TCP connect but its reply is
+                # blackholed — an unbounded sync call here would stall
+                # the grace loop far past the failover window
+                r = await conn.call_async(
+                    "journal_sync", {},
+                    timeout=max(0.5, min(10.0, per_addr)))
+            except Exception as e:
+                conn._do_close()
+                last = e
+                continue
+            if not (isinstance(r, dict) and r.get("ok")):
+                conn._do_close()
+                raise RuntimeError(
+                    f"journal_sync refused: {r!r} (primary journaling "
+                    "must be on for a standby to follow)")
+            self._load_sync(r)
+            self.conn = conn
+            self._synced = True
+            logger.info(
+                "synced to primary %s at epoch %d, seq %d "
+                "(%d kv keys, %d actors)", addr, self.primary_epoch,
+                self.applied_seq, len(self.server.kv),
+                len(self.server.actors))
+            return
+        raise last if last is not None else ConnectionError(
+            "no primary endpoints")
+
+    def _load_sync(self, r: Dict):
+        """Replace the warm server's tables with the sync snapshot and
+        reset the local journal under it — the snapshot supersedes every
+        record shipped before it."""
+        s = self.server
+        snap = r.get("snap") or {}
+        s.kv = dict(snap.get("kv") or {})
+        s.jobs = {bytes(k): v for k, v in (snap.get("jobs") or {}).items()}
+        s.actors = {}
+        s.named_actors = {}
+        s.placement_groups = {}
+        for d in snap.get("actors") or []:
+            rec = ActorRecord.from_state(d)
+            s.actors[rec.actor_id] = rec
+        for d in snap.get("pgs") or []:
+            rec = PgRecord.from_state(d)
+            s.placement_groups[rec.pg_id] = rec
+        s.autoscaler_intents = {
+            str(k): dict(v)
+            for k, v in (snap.get("intents") or {}).items()
+        }
+        self.primary_epoch = int(r.get("epoch") or 1)
+        s.epoch = self.primary_epoch
+        self.applied_seq = int(r.get("seq") or 0)
+        self.resyncs += 1
+        self.journal.reset()
+        self._records_since_snap = 0
+        # fold the bootstrap into a local snapshot so a standby crash
+        # right after sync restores to the same point
+        try:
+            self._local_compact_blocking()
+        except Exception:
+            logger.exception("standby bootstrap snapshot failed "
+                             "(journal still covers the stream)")
+
+    def _local_compact_blocking(self):
+        """Snapshot the warm tables + reset the local journal (sync/
+        promotion prep contexts where blocking the loop is fine: nothing
+        is being served and no batch handler runs concurrently)."""
+        snap = self.server._snapshot()
+        self.server._flush_snapshot(snap)
+        self.journal.reset()
+        self._records_since_snap = 0
+
+    async def _follow(self):
+        """FOLLOWING: batches arrive via rpc_journal_batch; this loop
+        only watches liveness — conn death, or (for a primary that is
+        reachable but reply-blackholed, e.g. a chaos partition) failed
+        probe pings."""
+        grace = max(0.2, GLOBAL_CONFIG.gcs_failover_grace_s)
+        period = max(0.1, grace / 4.0)
+        misses = 0
+        while self.conn is not None and not self.conn.closed:
+            await asyncio.sleep(period)
+            if self.conn.closed:
+                break
+            try:
+                r = await self.conn.call_async("gcs_probe", None,
+                                               timeout=max(1.0, grace))
+                misses = 0
+                ep = int(r.get("epoch") or 0) if isinstance(r, dict) else 0
+                if ep > self.primary_epoch:
+                    self.primary_epoch = ep  # journaled bump will follow
+            except Exception:
+                misses += 1
+                if misses >= 2:
+                    logger.warning(
+                        "primary probe missed %d times; treating the "
+                        "link as dead", misses)
+                    break
+        self._synced = False
+        if self.conn is not None:
+            self.conn._do_close()
+            self.conn = None
+
+    # ---------------- shipped-batch apply ----------------
+
+    async def rpc_journal_batch(self, conn, b):
+        """Apply one shipped group-commit batch: journal the raw frames
+        locally FIRST (crash safety), then apply through the standard
+        ``_journal_apply`` path, then ack the applied seq (the primary's
+        durable-at-ack gate waits on this)."""
+        if conn is not self.conn or not self._synced:
+            return True  # late frames from a superseded connection
+        epoch = int(b.get("epoch") or 0)
+        if epoch < self.primary_epoch:
+            # epoch fencing in the journal stream: a partitioned old
+            # primary's batches must never land on a standby that has
+            # seen a newer epoch
+            logger.warning(
+                "rejecting journal batch at stale epoch %d < %d",
+                epoch, self.primary_epoch)
+            return False
+        seq_from = int(b.get("seq") or 0)
+        frames: List[bytes] = [bytes(f) for f in (b.get("recs") or [])]
+        if seq_from > self.applied_seq:
+            # a batch went missing (dropped notify under chaos): the
+            # stream is no longer contiguous — resync from scratch
+            logger.warning(
+                "journal ship gap (batch starts at %d, applied %d); "
+                "resyncing", seq_from, self.applied_seq)
+            self._synced = False
+            conn._do_close()
+            return False
+        skip = self.applied_seq - seq_from
+        if skip >= len(frames):
+            return True  # wholly duplicate (pre-sync records)
+        fresh = frames[skip:]
+        self.journal.append_frames(fresh)
+        for fb in fresh:
+            try:
+                rec = rpc.msgpack.unpackb(fb[4:], raw=False)
+                self.server._journal_apply(rec)
+                if rec[0] == "epoch":
+                    self.primary_epoch = max(self.primary_epoch,
+                                             int(rec[1]))
+            except Exception:
+                logger.exception("bad shipped record skipped")
+        self.applied_seq = seq_from + len(frames)
+        self.batches_applied += 1
+        self._records_since_snap += len(fresh)
+        try:
+            await conn.notify_async("journal_ack",
+                                    {"seq": self.applied_seq})
+        except Exception:
+            pass  # conn died; the follow loop notices
+        if self._records_since_snap >= 50_000 and not self._compacting:
+            # bound promotion replay the same way the primary bounds
+            # restart replay: periodic local compaction
+            self._compacting = True
+            asyncio.get_running_loop().create_task(self._compact_async())
+        return True
+
+    async def _compact_async(self):
+        try:
+            s = self.server
+            snap = s._snapshot()  # loop-side copy (consistent)
+            self._records_since_snap = 0
+            await asyncio.to_thread(s._flush_snapshot, snap)
+        except Exception:
+            logger.exception("standby compaction failed (journal still "
+                             "covers the stream)")
+        finally:
+            self._compacting = False
+
+    # ---------------- promotion ----------------
+
+    async def _promote(self):
+        """PROMOTING: durable epoch bump, then the standard server start
+        against the preloaded tables. MTTR = grace (already spent) +
+        this method."""
+        t0 = time.monotonic()
+        self.promoted = True
+        new_epoch = self.primary_epoch + 1
+        # the fence record must be durable BEFORE the first bind: a
+        # crash mid-promotion must never come back serving the old epoch
+        self.journal.append(["epoch", new_epoch])
+        if not self.journal.fsync:
+            await asyncio.to_thread(os.fsync, self.journal._f.fileno())
+        self.server.epoch = new_epoch
+        logger.warning(
+            "promoting standby to GCS primary at epoch %d "
+            "(%d records applied in %d batches, %d resyncs)",
+            new_epoch, self.applied_seq, self.batches_applied,
+            self.resyncs)
+        await self.server.start(preloaded=True)
+        logger.warning("standby promoted: serving at %s (%.2fs)",
+                       self.sock_addr, time.monotonic() - t0)
+
+
+def main():
+    import argparse
+    import json
+    import sys
+
+    from ray_tpu._private import chaos
+    from ray_tpu._private.fate_share import fate_share_with_parent
+
+    fate_share_with_parent()
+    # chaos role deliberately avoids the "gcs" substring: partition/
+    # blackout rules targeting the primary (role/link "gcs") must not
+    # also silence the standby's links, or no schedule could express
+    # "partition the primary away from everyone but keep the standby
+    # reachable"
+    chaos.install_from_env("standby")
+    p = argparse.ArgumentParser()
+    p.add_argument("--sock")
+    p.add_argument("--primary")
+    p.add_argument("--storage")
+    p.add_argument("--config", default="")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[gcs-standby %(asctime)s] %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    if args.config:
+        GLOBAL_CONFIG.load(json.loads(args.config))
+
+    async def run() -> int:
+        sb = GcsStandby(args.sock, args.primary, args.storage)
+        await sb.run()
+        # now the serving primary: run until epoch-fenced by a newer
+        # peer (exit 3 = split-brain rejection, same as gcs.main)
+        await sb.server._fenced.wait()
+        return 3
+
+    sys.exit(asyncio.run(run()))
+
+
+if __name__ == "__main__":
+    main()

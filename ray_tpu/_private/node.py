@@ -162,14 +162,27 @@ class Cluster:
         self.gcs_sock = os.path.join(self.session_dir, "sockets", "gcs.sock")
         self._gcs_addr: Optional[str] = gcs_address
         self.gcs_proc: Optional[subprocess.Popen] = None
+        self.standby_proc: Optional[subprocess.Popen] = None
+        self._standby_addr: Optional[str] = None
+        self._standby_n = 0
         self.nodes: Dict[bytes, NodeProcs] = {}
         self.head_node: Optional[NodeProcs] = None
 
     @property
-    def gcs_addr(self):
+    def gcs_primary_addr(self):
         if self._gcs_addr is not None:
             return self._gcs_addr
         return "unix:" + self.gcs_sock
+
+    @property
+    def gcs_addr(self):
+        """The endpoint list clients dial. With a warm standby this is
+        "primary,standby" — every raylet/driver gets BOTH from boot, so
+        failover needs no address redistribution, just reconnect
+        cycling."""
+        if self._standby_addr is not None:
+            return self.gcs_primary_addr + "," + self._standby_addr
+        return self.gcs_primary_addr
 
     def start_gcs(self, system_config: Optional[Dict] = None,
                   wait: bool = True):
@@ -184,20 +197,74 @@ class Cluster:
         cfg_dict = dict(GLOBAL_CONFIG.dump())
         if system_config:
             cfg_dict.update(system_config)
+        self._gcs_cfg = cfg_dict
+        standby = bool(cfg_dict.get("gcs_standby"))
+        if standby:
+            # the standby's serving address is part of every client's
+            # endpoint list from boot, so it must be fixed NOW even
+            # though nothing binds it until promotion
+            if self.use_tcp:
+                self._standby_addr = (
+                    f"tcp:{self.node_ip}:{pick_free_port(self.node_ip)}"
+                )
+            else:
+                self._standby_addr = "unix:" + os.path.join(
+                    self.session_dir, "sockets", "gcs-standby.sock"
+                )
         self._gcs_cmd = [
             sys.executable, "-m", "ray_tpu._private.gcs",
-            "--sock", self.gcs_addr, "--config", json.dumps(cfg_dict),
+            "--sock", self.gcs_primary_addr,
+            "--config", json.dumps(cfg_dict),
         ]
-        if cfg_dict.get("gcs_storage_backend") == "file":
+        if cfg_dict.get("gcs_storage_backend") == "file" or standby:
+            # a standby implies journaling on the primary: journal_sync
+            # refuses otherwise (there is no stream to ship)
             self._gcs_cmd += [
                 "--storage", os.path.join(self.session_dir, "gcs_storage.pkl"),
             ]
+        if standby:
+            self._gcs_cmd += ["--peers", self._standby_addr]
         self.gcs_proc = _spawn(
             self._gcs_cmd,
             os.path.join(self.session_dir, "logs", "gcs.log"),
         )
+        if standby:
+            self.start_gcs_standby()
         if wait:
-            _wait_addr(self.gcs_addr, proc=self.gcs_proc)
+            _wait_addr(self.gcs_primary_addr, proc=self.gcs_proc)
+
+    def start_gcs_standby(self, sock_addr: Optional[str] = None,
+                          primary_addr: Optional[str] = None):
+        """Spawn a warm-standby GCS following ``primary_addr`` (defaults:
+        serve at the cluster's standby endpoint, follow the full endpoint
+        list — the standby syncs to whichever is serving). Reusable after
+        a failover to re-arm the NEXT failover: point a fresh standby at
+        the promoted primary. No ``_wait_addr``: a standby binds nothing
+        until promotion."""
+        self._standby_n += 1
+        self._standby_cmd = [
+            sys.executable, "-m", "ray_tpu._private.gcs_standby",
+            "--sock", sock_addr or self._standby_addr,
+            "--primary", primary_addr or self.gcs_addr,
+            "--storage", os.path.join(
+                self.session_dir, f"gcs_standby{self._standby_n}.pkl"),
+            "--config", json.dumps(self._gcs_cfg),
+        ]
+        self.standby_proc = _spawn(
+            self._standby_cmd,
+            os.path.join(self.session_dir, "logs",
+                         f"gcs-standby{self._standby_n}.log"),
+        )
+        return self.standby_proc
+
+    def kill_gcs(self):
+        """SIGKILL the primary GCS and leave it dead (failover testing —
+        the standby must take over). The primary's socket is deliberately
+        NOT unlinked: real failovers ride a dead-but-present address, and
+        clients must cycle past it, not get a clean FileNotFoundError."""
+        if self.gcs_proc is not None and self.gcs_proc.poll() is None:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait()
 
     def restart_gcs(self):
         """Kill + restart the GCS process (FT testing: with the file storage
@@ -206,8 +273,9 @@ class Cluster:
             self.gcs_proc.kill()
             self.gcs_proc.wait()
         # unix sockets must be unlinked before rebinding
-        if self.gcs_addr.startswith("unix:") or self.gcs_addr.startswith("/"):
-            path = self.gcs_addr.split(":", 1)[-1]
+        addr = self.gcs_primary_addr
+        if addr.startswith("unix:") or addr.startswith("/"):
+            path = addr.split(":", 1)[-1]
             try:
                 os.unlink(path)
             except OSError:
@@ -216,7 +284,7 @@ class Cluster:
             self._gcs_cmd,
             os.path.join(self.session_dir, "logs", "gcs-restarted.log"),
         )
-        _wait_addr(self.gcs_addr, proc=self.gcs_proc)
+        _wait_addr(addr, proc=self.gcs_proc)
 
     def add_node(
         self,
@@ -270,3 +338,7 @@ class Cluster:
             self.gcs_proc.kill()
             self.gcs_proc.wait()
         self.gcs_proc = None
+        if self.standby_proc is not None and self.standby_proc.poll() is None:
+            self.standby_proc.kill()
+            self.standby_proc.wait()
+        self.standby_proc = None

@@ -673,6 +673,126 @@ print(json.dumps(out))
 """
 
 
+_GCS_FAILOVER_CODE = """
+import json, os, subprocess, sys, tempfile, threading, time
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+GLOBAL_CONFIG.initialize()
+tmp = tempfile.mkdtemp(prefix="gcs_failover_bench")
+primary_sock = "unix:" + os.path.join(tmp, "gcs-primary.sock")
+standby_sock = "unix:" + os.path.join(tmp, "gcs-standby.sock")
+multi = primary_sock + "," + standby_sock
+cfg = dict(
+    GLOBAL_CONFIG.dump(),
+    gcs_storage_backend="file",
+    gcs_standby=True,
+    gcs_standby_ack=True,            # durable-at-ack = standby-applied
+    gcs_snapshot_interval_s=3600.0,  # the journal carries everything
+    gcs_failover_grace_s=1.0,
+)
+primary_cmd = [
+    sys.executable, "-m", "ray_tpu._private.gcs",
+    "--sock", primary_sock, "--config", json.dumps(cfg),
+    "--storage", os.path.join(tmp, "gcs.pkl"),
+    "--peers", standby_sock,
+]
+primary = subprocess.Popen(primary_cmd, stderr=subprocess.DEVNULL)
+standby = subprocess.Popen(
+    [sys.executable, "-m", "ray_tpu._private.gcs_standby",
+     "--sock", standby_sock, "--primary", primary_sock,
+     "--storage", os.path.join(tmp, "gcs-standby.pkl"),
+     "--config", json.dumps(cfg)],
+    stderr=subprocess.DEVNULL,
+)
+probe = rpc.Client.connect(multi, timeout=30, name="bench-probe")
+deadline = time.monotonic() + 30
+while True:
+    st = probe.call("internal_state", None, timeout=10)
+    if st["standbys"] == 1:
+        break
+    assert time.monotonic() < deadline, "standby never subscribed"
+    time.sleep(0.1)
+
+out = {}
+N_THREADS = 8
+acked = [[] for _ in range(N_THREADS)]
+stop = threading.Event()
+clis = [rpc.Client.connect(multi, name=f"mut{i}") for i in range(N_THREADS)]
+for c in clis:
+    c.call("ping", None, timeout=10)
+
+
+def run(i):
+    c, k = clis[i], 0
+    while not stop.is_set():
+        try:
+            if c.call("kv_put", [f"fo:{i}:{k}", b"v" * 32, True],
+                      timeout=30):
+                acked[i].append(k)
+        except Exception:
+            pass  # un-acked: allowed to be lost
+        k += 1
+
+
+ts = [threading.Thread(target=run, args=(i,)) for i in range(N_THREADS)]
+t0 = time.monotonic()
+for t in ts:
+    t.start()
+time.sleep(1.2)  # sustained load window before the kill
+pre_kill_acks = sum(len(a) for a in acked)
+t_kill = time.monotonic()
+out["load_mutations_per_s"] = round(pre_kill_acks / (t_kill - t0), 1)
+primary.kill()
+primary.wait()
+
+# MTTR: first successful control-plane RPC served by the PROMOTED
+# standby (epoch 2) after the SIGKILL, measured through the same
+# multi-endpoint reconnect cycling every client uses
+while True:
+    try:
+        st = probe.call("internal_state", None, timeout=5)
+        if st["epoch"] >= 2:
+            break
+    except Exception:
+        pass
+    assert time.monotonic() - t_kill < 60, "standby never promoted"
+    time.sleep(0.05)
+out["gcs_failover_mttr_s"] = round(time.monotonic() - t_kill, 2)
+
+time.sleep(1.0)  # keep mutating against the new primary
+stop.set()
+for t in ts:
+    t.join(timeout=120)
+out["total_acked"] = sum(len(a) for a in acked)
+
+# zero lost acks: every mutation a client saw acked must be readable
+# at the promoted primary
+lost = 0
+for i in range(N_THREADS):
+    for k in acked[i]:
+        if probe.call("kv_get", f"fo:{i}:{k}", timeout=15) != b"v" * 32:
+            lost += 1
+out["acks_lost"] = lost
+
+# split-brain rejection: the resurrected old primary must fence itself
+# against the promoted peer and exit 3
+old = subprocess.Popen(primary_cmd, stderr=subprocess.DEVNULL)
+rc = old.wait(timeout=30)
+out["old_primary_fenced"] = 1 if rc == 3 else 0
+st = probe.call("internal_state", None, timeout=10)
+out["post_failover_epoch"] = st["epoch"]
+
+for c in clis:
+    c.close()
+probe.close()
+standby.kill(); standby.wait()
+
+print(json.dumps(out))
+"""
+
+
 _DATA_PLANE_CODE = """
 import json, os, time
 
@@ -964,6 +1084,16 @@ def run_gcs_plane_bench() -> Dict[str, float]:
     journal replay entries/s (restore-time bound). Subprocess-isolated
     like the transfer bench."""
     return _run_isolated("gcs plane", _GCS_PLANE_CODE, timeout=600)
+
+
+def run_gcs_failover_bench() -> Dict[str, float]:
+    """Warm-standby failover micro (r16): SIGKILL the primary GCS under
+    sustained concurrent mutations and measure MTTR to the first RPC
+    served by the promoted standby, acked-mutations lost (hard-gated to
+    zero: ship acks make "durable" mean standby-applied), and the
+    split-brain leg (a resurrected old primary must epoch-fence itself
+    out, exit 3). Subprocess-isolated."""
+    return _run_isolated("gcs failover", _GCS_FAILOVER_CODE, timeout=600)
 
 
 def run_mesh_group_bench() -> Dict[str, float]:

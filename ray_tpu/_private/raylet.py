@@ -298,7 +298,16 @@ class Raylet:
         self.node_id = node_id
         self.sock_path = sock_path
         self.store_path = store_path
+        # gcs_addr may be a comma-separated endpoint list (primary +
+        # warm standby): the raylet cycles it on reconnect, so after a
+        # failover the same loop that handles a GCS restart lands on
+        # the promoted standby. Kept as the raw multi-string too —
+        # spawned workers inherit the full list.
         self.gcs_addr = gcs_addr
+        self.gcs_addrs = [a.strip() for a in gcs_addr.split(",")
+                          if a.strip()]
+        self._gcs_addr_i = 0
+        self._gcs_epoch: Optional[int] = None
         self.session_dir = session_dir
         self.labels = labels or {}
         self.total_resources = dict(resources)
@@ -468,9 +477,28 @@ class Raylet:
             self.store.close()
 
     async def _connect_gcs(self) -> rpc.Connection:
-        return await rpc.connect_async(
-            self.gcs_addr, rpc.handler_table(self), timeout=30, name="raylet->gcs"
-        )
+        """Connect to the first reachable GCS endpoint, cycling the list
+        across calls. First boot is patient (the GCS may still be
+        binding); reconnects use a short per-endpoint timeout so a dead
+        primary costs one hop, not the whole failover budget — the
+        reconnect loop's backoff provides the patience."""
+        first_boot = self.gcs is None
+        per_addr = 30.0 if first_boot and len(self.gcs_addrs) == 1 \
+            else (10.0 if first_boot else 2.0)
+        last: Optional[Exception] = None
+        for _ in range(len(self.gcs_addrs)):
+            addr = self.gcs_addrs[self._gcs_addr_i % len(self.gcs_addrs)]
+            try:
+                return await rpc.connect_async(
+                    addr, rpc.handler_table(self), timeout=per_addr,
+                    name="raylet->gcs",
+                )
+            except Exception as e:
+                last = e
+                self._gcs_addr_i = (self._gcs_addr_i + 1) % len(
+                    self.gcs_addrs)
+        raise last if last is not None else ConnectionError(
+            "no GCS endpoints")
 
     async def _gcs_call_replayed(self, method, data, timeout=10.0,
                                  attempts=6):
@@ -512,6 +540,18 @@ class Raylet:
                 labels=self.labels,
             ).to_wire(),
         )
+        ep = reply.get("epoch") if isinstance(reply, dict) else None
+        if ep is not None:
+            if self._gcs_epoch is not None and int(ep) < self._gcs_epoch:
+                # epoch fencing: this endpoint is a resurrected old
+                # primary (it will fence itself shortly) — refuse it and
+                # let the reconnect loop cycle to the promoted standby
+                self._gcs_addr_i = (self._gcs_addr_i + 1) % len(
+                    self.gcs_addrs)
+                raise ConnectionError(
+                    f"GCS at stale epoch {ep} < {self._gcs_epoch}; "
+                    "cycling to the promoted endpoint")
+            self._gcs_epoch = int(ep)
         GLOBAL_CONFIG.load(reply["config"])
         # the read caches are only coherent while subscribed: a
         # (re-)registration starts a fresh subscription epoch, so drop
@@ -730,6 +770,7 @@ class Raylet:
 
     async def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.health_check_period_ms / 1e3
+        misses = 0
         while not self._stopping:
             try:
                 reply = await self.gcs.call_async(
@@ -742,6 +783,7 @@ class Raylet:
                     ],
                     timeout=10,
                 )
+                misses = 0
                 if isinstance(reply, dict) and reply.get("reregister"):
                     # The GCS doesn't know us (restarted, or it declared us
                     # dead during a partition/blackout): cycle the conn —
@@ -754,6 +796,19 @@ class Raylet:
             except Exception:
                 if self._stopping:
                     return
+                # A partitioned (not dead) GCS keeps the TCP conn open
+                # while answering nothing: conn-close never fires, so
+                # consecutive heartbeat timeouts are the only failover
+                # signal. Cycle the conn — the reconnect loop walks the
+                # endpoint list and lands on the promoted standby.
+                misses += 1
+                if misses >= 2 and self.gcs is not None \
+                        and not self.gcs.closed:
+                    logger.warning(
+                        "GCS unresponsive for %d heartbeats; cycling "
+                        "the connection", misses)
+                    misses = 0
+                    self.gcs._do_close()
             self._pump_infeasible(expire=True)
             await asyncio.sleep(period)
 

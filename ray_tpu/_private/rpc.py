@@ -28,6 +28,7 @@ import collections
 import itertools
 import logging
 import os
+import re
 import threading
 import time
 import traceback
@@ -199,6 +200,10 @@ class Connection:
         # tags a registering raylet's conn) so node-pair partitions match
         self.chaos_peer = ""
         self._chaos_seq = 0
+        # last GCS epoch seen in a reply on this conn (None until the
+        # peer stamps one): failover fencing — clients reject peers
+        # whose epoch regresses below the highest they have witnessed
+        self.peer_epoch: Optional[int] = None
 
     def start(self):
         self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -218,9 +223,13 @@ class Connection:
                 msg = msgpack.unpackb(body, raw=False)
                 kind, seqno, method, data = msg[0], msg[1], msg[2], msg[3]
                 rid = msg[4] if len(msg) > 4 else None
+                # element 5 = GCS epoch: on requests, the epoch the
+                # caller minted the request under (fencing input); on
+                # replies, the epoch the server is serving at
+                epoch = msg[5] if len(msg) > 5 else None
                 if kind == _REQUEST:
                     asyncio.get_running_loop().create_task(
-                        self._handle(seqno, method, data, rid)
+                        self._handle(seqno, method, data, rid, epoch)
                     )
                 elif kind == _NOTIFY:
                     fn = self.sync_notify.get(method)
@@ -236,6 +245,8 @@ class Connection:
                             self._handle(None, method, data)
                         )
                 elif kind in (_REPLY, _ERROR):
+                    if epoch is not None:
+                        self.peer_epoch = epoch
                     fut = self._pending.pop(seqno, None)
                     if fut is not None and not fut.done():
                         if kind == _REPLY:
@@ -286,10 +297,10 @@ class Connection:
                         "raw notify handler %s failed", method
                     )
 
-    async def _handle(self, seqno, method, data, rid=None):
+    async def _handle(self, seqno, method, data, rid=None, epoch=None):
         t0 = time.monotonic()
         kind, payload = await run_idempotent(
-            rid, lambda: self.handler(self, method, data)
+            rid, lambda: self.handler(self, method, data), epoch=epoch
         )
         if kind == _REPLY:
             _global_stats.record(method, (time.monotonic() - t0) * 1e3)
@@ -316,7 +327,11 @@ class Connection:
                     pass
                 return
             try:
-                await self._send(kind, seqno, method, payload)
+                await self._send(
+                    kind, seqno, method, payload,
+                    epoch=None if _EPOCH_PROVIDER is None
+                    else _EPOCH_PROVIDER(),
+                )
             except Exception:
                 pass
 
@@ -347,14 +362,16 @@ class Connection:
             _write()
         return True
 
-    async def _send(self, kind, seqno, method, data, rid=None):
+    async def _send(self, kind, seqno, method, data, rid=None, epoch=None):
         # Hot path: ONE buffer append per frame (the transport coalesces
         # same-tick frames into one syscall) and drain only past the
         # high-water mark — per-frame drain() costs a task switch each
         # and throttled nothing below the watermark anyway.
         msg = [kind, seqno, method, data]
-        if rid is not None:
+        if rid is not None or epoch is not None:
             msg.append(rid)
+        if epoch is not None:
+            msg.append(epoch)
         body = msgpack.packb(msg, use_bin_type=True)
         if self._closed or self.writer.is_closing():
             raise ConnectionError(f"connection {self.name} closed")
@@ -424,13 +441,13 @@ class Connection:
             self._raw_sinks.pop(seqno, None)
 
     async def call_async(self, method: str, data: Any, timeout=None,
-                         rid=None) -> Any:
+                         rid=None, epoch=None) -> Any:
         seqno = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seqno] = fut
         try:
             try:
-                await self._send(_REQUEST, seqno, method, data, rid)
+                await self._send(_REQUEST, seqno, method, data, rid, epoch)
             except Exception as e:
                 raise SendError(str(e)) from e
             if timeout is not None:
@@ -527,6 +544,43 @@ class SendError(ConnectionError):
     """The request was never written to the socket (safe to retry)."""
 
 
+# ---------------- GCS epoch (failover fencing) ----------------
+# Set ONLY by a serving GCS (one per process). When set, every reply
+# this process sends is stamped with the current epoch, and inbound
+# requests minted under a LOWER epoch are refused with a typed
+# StaleEpochError instead of silently re-executed: the old primary's
+# request-id dedup cache died with it, so an old-epoch replay may
+# duplicate a mutation whose first attempt is already in the journal
+# the new primary restored from.
+
+_EPOCH_PROVIDER: Optional[Callable[[], int]] = None
+
+
+def set_epoch_provider(fn: Optional[Callable[[], int]]):
+    global _EPOCH_PROVIDER
+    _EPOCH_PROVIDER = fn
+
+
+_STALE_EPOCH_MARK = "StaleEpochError"
+
+
+def stale_epoch_payload(req_epoch: int, cur_epoch: int) -> str:
+    return (
+        f"{_STALE_EPOCH_MARK}: request epoch {req_epoch} < GCS epoch "
+        f"{cur_epoch}; the primary that minted it was failed over — "
+        "re-verify against journal-restored state with a fresh request"
+    )
+
+
+def parse_stale_epoch(text: str) -> Optional[int]:
+    """The serving epoch out of a StaleEpochError payload (errors travel
+    as strings on the wire), or None if this is not one."""
+    if _STALE_EPOCH_MARK not in text:
+        return None
+    m = re.search(r"< GCS epoch (\d+)", text)
+    return int(m.group(1)) if m else None
+
+
 # ---------------- request-id dedup (idempotent apply) ----------------
 # At-least-once transport (client replays across reconnects/timeouts)
 # + this = effectively-once: a retried mutation is applied ONCE and the
@@ -543,12 +597,22 @@ _dedup_done: "collections.OrderedDict[bytes, tuple]" = collections.OrderedDict()
 _dedup_inflight: Dict[bytes, asyncio.Future] = {}
 
 
-async def run_idempotent(rid, thunk) -> tuple:
+async def run_idempotent(rid, thunk, epoch=None) -> tuple:
     """Run ``await thunk()`` under request-id dedup. Returns
     ``(_REPLY, reply)`` or ``(_ERROR, traceback_str)`` — for a duplicate
     rid the stored outcome is returned without re-running the handler;
-    a duplicate racing an in-flight first attempt awaits that attempt."""
+    a duplicate racing an in-flight first attempt awaits that attempt.
+
+    ``epoch``: the GCS epoch the request was minted under. A dedup-cache
+    HIT is always served (the outcome is known — replaying it is safe at
+    any epoch), but a MISS whose epoch predates this server's is refused
+    typed (StaleEpochError) instead of re-executed: the dedup entry that
+    would have made the replay safe lived in the failed-over primary."""
     if rid is None:
+        if epoch is not None and _EPOCH_PROVIDER is not None:
+            cur = _EPOCH_PROVIDER()
+            if epoch < cur:
+                return (_ERROR, stale_epoch_payload(epoch, cur))
         try:
             return (_REPLY, await thunk())
         except Exception:
@@ -558,6 +622,14 @@ async def run_idempotent(rid, thunk) -> tuple:
     if hit is not None:
         _dedup_done.move_to_end(rid)
         return hit
+    if epoch is not None and _EPOCH_PROVIDER is not None:
+        cur = _EPOCH_PROVIDER()
+        if epoch < cur:
+            # NOT cached under rid: the caller's recovery is a FRESH rid
+            # under the new epoch, and a concurrent duplicate of this
+            # stale one should get the same typed refusal, not a cache
+            # entry pinning it
+            return (_ERROR, stale_epoch_payload(epoch, cur))
     inflight = _dedup_inflight.get(rid)
     if inflight is not None:
         return await asyncio.shield(inflight)
@@ -645,22 +717,39 @@ class Client:
     (GCS fault tolerance: the file-backed GCS comes back at the same
     address).
 
+    ``addr`` may be a comma-separated endpoint list (GCS warm standby:
+    "primary,standby"): reconnects CYCLE through the list with the same
+    jittered backoff, so a failed-over client lands on whichever
+    endpoint is serving. The client tracks the highest GCS epoch seen in
+    replies and refuses to keep talking to an endpoint whose epoch
+    regresses (a resurrected old primary) — it cycles onward instead.
+
     Delivery semantics: ``call`` on an address-remembering client is
     AT-LEAST-ONCE with idempotent apply — every attempt carries one
     request id, the client replays across reconnects / per-attempt
     timeouts with exponential backoff + jitter, and the server's
     request-id dedup (``run_idempotent``) applies the mutation once and
-    replays the cached reply. Pass ``retry=False`` for fire-once."""
+    replays the cached reply. Across a FAILOVER the dedup cache is gone:
+    a replay reaching the new primary under the old epoch comes back as
+    a typed StaleEpochError and ``call`` recovers by reissuing ONE
+    fresh-rid attempt under the new epoch (safe: every control-plane
+    mutation is app-level idempotent against journal-restored state —
+    the PR 1 contract). Pass ``retry=False`` for fire-once."""
 
     def __init__(self, conn: Connection, io: EventLoopThread,
                  addr: str = "", handler=None, name: str = ""):
         self.conn = conn
         self.io = io
+        self._addrs = [a for a in (addr.split(",") if addr else []) if a]
+        self._addr_i = 0
         self._addr = addr
         self._handler = handler
         self._name = name
         self._reconnect_lock = threading.Lock()
         self._closed_by_user = False
+        # highest GCS epoch witnessed in any reply (None until the
+        # server plane stamps epochs): the client-side fencing floor
+        self._epoch: Optional[int] = None
         # backoff jitter: seeded under an installed chaos plane so a
         # replayed fault schedule sees the same retry timing (raylint
         # R4). The pid decorrelates processes whose clients share a
@@ -674,30 +763,84 @@ class Client:
         # replay pubsub subscriptions the restarted server lost)
         self.on_reconnect = None
 
-    @classmethod
-    def connect(cls, addr: str, handler=None, timeout=30.0, name="") -> "Client":
+    @staticmethod
+    def _norm(addr: str) -> str:
         if ":" not in addr or addr.startswith("/"):
             addr = "unix:" + addr  # back-compat: bare socket path
+        return addr
+
+    @classmethod
+    def connect(cls, addr: str, handler=None, timeout=30.0, name="") -> "Client":
+        addrs = [cls._norm(a.strip())
+                 for a in addr.split(",") if a.strip()]
         io = EventLoopThread.get()
-        return cls(
-            io.run(connect_async(addr, handler, timeout, name)),
-            io, addr=addr, handler=handler, name=name,
-        )
+        conn = None
+        last: Optional[Exception] = None
+        # bootstrap: the FIRST endpoint is the primary and gets most of
+        # the budget; a cold standby doesn't even bind its socket, so
+        # later endpoints only matter when a client boots mid-failover
+        per = timeout if len(addrs) == 1 else max(2.0, timeout / len(addrs))
+        idx = 0
+        for i, a in enumerate(addrs):
+            try:
+                conn = io.run(connect_async(a, handler, per, name))
+                idx = i
+                break
+            except Exception as e:
+                last = e
+        if conn is None:
+            raise last if last is not None else ConnectionError(
+                f"no endpoints in {addr!r}"
+            )
+        cli = cls(conn, io, addr=",".join(addrs), handler=handler, name=name)
+        cli._addr_i = idx
+        return cli
 
     def _maybe_reconnect(self, timeout: float = 10.0):
-        if not self.conn.closed or not self._addr or self._closed_by_user:
+        if not self.conn.closed or not self._addrs or self._closed_by_user:
             return
         with self._reconnect_lock:  # one reconnect wins; no orphan conns
             if self.conn.closed and not self._closed_by_user:
-                self.conn = self.io.run(
-                    connect_async(self._addr, self._handler, timeout,
-                                  self._name)
-                )
+                last: Optional[Exception] = None
+                for _ in range(len(self._addrs)):
+                    a = self._addrs[self._addr_i]
+                    try:
+                        self.conn = self.io.run(
+                            connect_async(a, self._handler, timeout,
+                                          self._name)
+                        )
+                        break
+                    except Exception as e:
+                        last = e
+                        # cycle: the next retry round starts at the
+                        # following endpoint (failover rotation)
+                        self._addr_i = (self._addr_i + 1) % len(self._addrs)
+                else:
+                    raise last  # every endpoint refused this round
                 if self.on_reconnect is not None:
                     try:
                         self.on_reconnect(self)
                     except Exception:
                         pass
+
+    def _adopt_peer_epoch(self):
+        """After a successful call: fold the conn's reply epoch into the
+        client floor; a REGRESSION (resurrected old primary) drops the
+        conn and rotates to the next endpoint, telling the caller to
+        retry. Runs on the calling thread — conn swap races are benign
+        (worst case an extra reconnect cycle)."""
+        pe = self.conn.peer_epoch
+        if pe is None:
+            return
+        if self._epoch is not None and pe < self._epoch:
+            self.io.call_soon(self.conn._do_close)
+            if self._addrs:
+                self._addr_i = (self._addr_i + 1) % len(self._addrs)
+            raise ConnectionError(
+                f"GCS epoch regressed ({pe} < {self._epoch}): stale "
+                "primary resurrected; cycling endpoints"
+            )
+        self._epoch = pe
 
     @staticmethod
     def _cfg(name: str, default: float) -> float:
@@ -714,9 +857,12 @@ class Client:
             retry = bool(self._addr)
         if not retry:
             self._maybe_reconnect()
-            return self.io.run(
-                self.conn.call_async(method, data, timeout=timeout)
+            out = self.io.run(
+                self.conn.call_async(method, data, timeout=timeout,
+                                     epoch=self._epoch)
             )
+            self._adopt_peer_epoch()
+            return out
         # At-least-once replay: per-attempt timeout, exponential backoff +
         # jitter between attempts. An EXPLICIT caller timeout stays the
         # TOTAL bound (status paths keep their latency contract); with no
@@ -745,6 +891,11 @@ class Client:
         backoff = 0.05
         attempt = 0
         conn_failures = 0  # consecutive cannot-even-connect failures
+        # epoch the request is minted under (stamped on every replay of
+        # this rid): a failover mid-call surfaces as StaleEpochError from
+        # the NEW primary, recovered below with ONE fresh-rid reissue
+        req_epoch = self._epoch
+        stale_reissued = False
         while True:
             attempt_timeout = min(cap, 1.0 * (1 << min(attempt, 6)))
             if timeout is not None:
@@ -768,11 +919,29 @@ class Client:
                     if conn_failures >= 4:
                         raise
                     raise ConnectionError("reconnect failed") from e
-                return self.io.run(self.conn.call_async(
-                    method, data, timeout=attempt_timeout, rid=rid
+                out = self.io.run(self.conn.call_async(
+                    method, data, timeout=attempt_timeout, rid=rid,
+                    epoch=req_epoch,
                 ))
-            except RpcError:
-                raise
+                self._adopt_peer_epoch()
+                return out
+            except RpcError as e:
+                new_epoch = parse_stale_epoch(str(e))
+                if new_epoch is None:
+                    raise
+                # the request predates a failover: the new primary holds
+                # every mutation the OLD one acked (journal-restored)
+                # but not its dedup cache — reissue ONCE, fresh rid,
+                # under the new epoch (app-idempotent => effectively-
+                # once); a second stale refusal surfaces typed
+                from ray_tpu.exceptions import StaleEpochError
+                if stale_reissued or time.monotonic() > deadline:
+                    raise StaleEpochError(str(e)) from e
+                stale_reissued = True
+                self._epoch = req_epoch = max(self._epoch or 0, new_epoch)
+                if rid is not None:
+                    rid = os.urandom(16)
+                continue
             except (ConnectionError, OSError, asyncio.TimeoutError,
                     TimeoutError):
                 if self._closed_by_user:

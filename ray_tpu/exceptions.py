@@ -120,6 +120,18 @@ class PendingCallsLimitExceeded(RayTpuError):
     pass
 
 
+class StaleEpochError(RayTpuError):
+    """A control-plane request minted under an old GCS epoch reached a
+    GCS serving at a newer one (the primary that issued the epoch was
+    failed over). The request was NOT executed: the old primary's
+    request-id dedup cache died with it, so silently re-running the
+    replay could double-apply a mutation whose first attempt is already
+    in the journal the new primary restored from. Callers re-verify
+    against journal-restored state (the PR 1 app-level idempotence
+    contract) — the managed ``rpc.Client`` does this automatically by
+    reissuing ONE fresh-rid attempt under the new epoch."""
+
+
 class BackpressureError(RayTpuError):
     """Serve router admission rejected the request: every replica is at
     its in-flight cap and the router's bounded queue is full (or the

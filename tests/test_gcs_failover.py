@@ -1,0 +1,399 @@
+"""GCS warm-standby failover tests (r16).
+
+Covers the r16 contracts:
+- ``GcsJournalTailer`` hands off ``.old`` -> current at a record-exact
+  boundary for EVERY possible read position around a rotation, and
+  rewinds (never splits) a partially-flushed frame;
+- epoch fencing in ``run_idempotent``: a dedup MISS minted under an old
+  GCS epoch is refused typed (StaleEpochError) instead of re-executed,
+  a dedup HIT is served at any epoch, and the managed ``rpc.Client``
+  recovers transparently with ONE fresh-rid reissue;
+- the tentpole end to end: SIGKILL the primary under concurrent
+  mutations -> the standby promotes (epoch+1), every acked mutation is
+  present, no false node deaths, the driver keeps working, and an
+  old-epoch replay at the new primary gets the typed refusal;
+- (slow) soak: failover driven by a seeded chaos partition of the
+  primary, the muted old primary fences itself out when the partition
+  heals (split-brain rejection, exit 3), autoscaler heal intents
+  survive promotion, and a re-armed standby carries a SECOND failover.
+"""
+
+import os
+import threading
+import time
+
+import msgpack
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos, rpc
+from ray_tpu._private.gcs import GcsJournal, GcsJournalTailer
+from ray_tpu._private.test_utils import network_chaos
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import StaleEpochError
+
+# ------------------------------------------------------------- tailer
+
+
+def _decode(frames):
+    return [msgpack.unpackb(fb[4:], raw=False) for fb in frames]
+
+
+def test_tailer_rotation_handoff_at_every_boundary(tmp_path):
+    """For every read position r in a K-record segment: rotate, append
+    to the fresh segment, and one ``read_new`` must yield exactly the
+    unread tail of the OLD segment plus the new records — each once, in
+    order. (The rotation-vs-catch-up race: the tailer's open fd keeps
+    the renamed segment readable; it drains that tail BEFORE reopening
+    the current file.)"""
+    K = 5
+    for r in range(K + 1):
+        p = str(tmp_path / f"j{r}")
+        j = GcsJournal(p)
+        t = GcsJournalTailer(p)
+        for i in range(r):
+            j.append(["kv", f"pre{i}", b"a"])
+        assert _decode(t.read_new()) == [["kv", f"pre{i}", b"a"]
+                                         for i in range(r)]
+        for i in range(r, K):
+            j.append(["kv", f"pre{i}", b"a"])
+        old = j.rotate()
+        j.append(["kv", "post0", b"b"])
+        j.append(["kv", "post1", b"b"])
+        got = _decode(t.read_new())
+        assert got == (
+            [["kv", f"pre{i}", b"a"] for i in range(r, K)]
+            + [["kv", "post0", b"b"], ["kv", "post1", b"b"]]
+        ), (r, got)
+        assert t.rotations == 1
+        assert t.records == K + 2
+        t.close()
+        j.close()
+        os.unlink(old)
+
+
+def test_tailer_rotation_with_empty_new_segment(tmp_path):
+    """Rotation with nothing appended after it: the tailer must still
+    drain the old tail and reopen cleanly (no spin, no loss)."""
+    p = str(tmp_path / "j")
+    j = GcsJournal(p)
+    t = GcsJournalTailer(p)
+    j.append(["kv", "a", b"1"])
+    j.rotate()
+    assert _decode(t.read_new()) == [["kv", "a", b"1"]]
+    assert t.read_new() == []
+    j.append(["kv", "b", b"2"])
+    assert _decode(t.read_new()) == [["kv", "b", b"2"]]
+    t.close()
+    j.close()
+
+
+def test_tailer_rewinds_partial_frame(tmp_path):
+    """A frame whose tail hasn't been flushed yet must be rewound whole:
+    the next read yields it exactly once, never split or skipped."""
+    p = str(tmp_path / "j")
+    body = msgpack.packb(["kv", "k", b"v" * 10], use_bin_type=True)
+    frame = len(body).to_bytes(4, "big") + body
+    for cut in range(1, len(frame)):
+        with open(p, "wb") as f:
+            f.write(frame[:cut])
+        t = GcsJournalTailer(p)
+        assert t.read_new() == []
+        with open(p, "ab") as f:
+            f.write(frame[cut:] + frame)  # finish the tear + one more
+        assert _decode(t.read_new()) == [["kv", "k", b"v" * 10]] * 2
+        t.close()
+
+
+# ------------------------------------------------ epoch fencing (rpc)
+
+
+def _epoch_srv(tmp_path, io, applied):
+    async def handler(conn, method, data):
+        applied[data] = applied.get(data, 0) + 1
+        return applied[data]
+
+    srv = rpc.Server(f"unix:{tmp_path}/epoch.sock", handler, name="epoch-srv")
+    io.run(srv.start_async())
+    return srv
+
+
+def test_stale_epoch_miss_refused_hit_served(tmp_path):
+    """The replay contract after failover: a dedup MISS minted under an
+    old epoch is refused typed WITHOUT running the handler (the old
+    primary's dedup cache died with it, so re-running could double-
+    apply); a dedup HIT is served at any epoch (its outcome is known)."""
+    applied = {}
+    io = rpc.EventLoopThread.get()
+    srv = _epoch_srv(tmp_path, io, applied)
+    rpc.set_epoch_provider(lambda: 2)
+    try:
+        conn = io.run(rpc.connect_async(f"unix:{tmp_path}/epoch.sock"))
+        rid = os.urandom(16)
+        assert io.run(conn.call_async("apply", "a", rid=rid, epoch=2,
+                                      timeout=5)) == 1
+        # same-rid replay at the SAME epoch: dedup HIT, not re-run
+        assert io.run(conn.call_async("apply", "a", rid=rid, epoch=2,
+                                      timeout=5)) == 1
+        # old-epoch MISS: typed refusal carrying the new epoch
+        with pytest.raises(rpc.RpcError) as ei:
+            io.run(conn.call_async("apply", "b", rid=os.urandom(16),
+                                   epoch=1, timeout=5))
+        assert "StaleEpochError" in str(ei.value)
+        assert rpc.parse_stale_epoch(str(ei.value)) == 2
+        assert "b" not in applied, "stale replay was executed"
+        # old-epoch HIT: still served from the dedup cache
+        assert io.run(conn.call_async("apply", "a", rid=rid, epoch=1,
+                                      timeout=5)) == 1
+        assert applied == {"a": 1}
+        io.call_soon(conn._do_close)
+    finally:
+        rpc.set_epoch_provider(None)
+        io.run(srv.stop_async())
+
+
+def test_client_recovers_stale_epoch_with_one_fresh_rid(tmp_path):
+    """The managed Client path: a call minted under a pre-failover epoch
+    hits the new primary, gets the typed refusal, and transparently
+    reissues ONCE under a fresh rid + the adopted epoch — the handler
+    runs exactly once and the client's epoch floor advances."""
+    applied = {}
+    io = rpc.EventLoopThread.get()
+    srv = _epoch_srv(tmp_path, io, applied)
+    rpc.set_epoch_provider(lambda: 5)
+    try:
+        cli = rpc.Client.connect(f"unix:{tmp_path}/epoch.sock",
+                                 name="failover-cli")
+        cli._epoch = 3  # minted under the failed-over primary
+        assert cli.call("apply", "x", timeout=10) == 1
+        assert applied == {"x": 1}
+        assert cli._epoch == 5
+        cli.close()
+    finally:
+        rpc.set_epoch_provider(None)
+        io.run(srv.stop_async())
+
+
+# --------------------------------------------------- tentpole failover
+
+
+def test_failover_zero_lost_acks_no_false_deaths():
+    """SIGKILL the primary GCS with concurrent in-flight mutations: the
+    warm standby promotes to epoch 2, EVERY acked mutation is readable
+    at the new primary, raylets re-register (no false node deaths, no
+    gang teardowns), the driver keeps submitting tasks, and an
+    old-epoch replay gets the typed StaleEpochError refusal."""
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2}},
+        system_config={
+            "gcs_storage_backend": "file",
+            "gcs_standby": True,
+            "gcs_snapshot_interval_s": 3600.0,  # journal carries everything
+            "gcs_failover_grace_s": 1.0,
+        },
+        use_tcp=True,
+    )
+    c.connect()
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        gcs = global_worker.core_worker.gcs
+        st = gcs.call("internal_state", None, timeout=10)
+        assert st["epoch"] == 1 and st["standbys"] == 1, st
+
+        n_threads = 4
+        acked = [[] for _ in range(n_threads)]
+        stop = threading.Event()
+        clis = [rpc.Client.connect(c._impl.gcs_addr, name=f"mut{i}")
+                for i in range(n_threads)]
+
+        def put(i):
+            k = 0
+            while not stop.is_set():
+                try:
+                    if clis[i].call("kv_put", [f"fo:{i}:{k}", b"d", True],
+                                    timeout=20):
+                        acked[i].append(k)
+                except Exception:
+                    pass  # un-acked: allowed to be lost
+                k += 1
+
+        ts = [threading.Thread(target=put, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        time.sleep(0.5)  # in-flight mutations when the primary dies
+        c._impl.kill_gcs()
+        time.sleep(3.0)  # mutate THROUGH the failover
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+        assert sum(len(a) for a in acked) > 100
+
+        st = gcs.call("internal_state", None, timeout=30)
+        assert st["epoch"] == 2, st
+        # zero lost acks: every mutation a client saw acked is present
+        lost = [
+            (i, k)
+            for i in range(n_threads)
+            for k in acked[i]
+            if gcs.call("kv_get", f"fo:{i}:{k}", timeout=10) != b"d"
+        ]
+        assert not lost, f"{len(lost)} acked mutations lost: {lost[:10]}"
+
+        # old-epoch replay at the NEW primary: typed refusal, never
+        # silently re-executed (the raw conn bypasses Client recovery)
+        io = rpc.EventLoopThread.get()
+        conn = io.run(rpc.connect_async(c._impl._standby_addr))
+        with pytest.raises(rpc.RpcError) as ei:
+            io.run(conn.call_async(
+                "kv_put", ["fo:replay", b"x", True],
+                rid=os.urandom(16), epoch=1, timeout=5))
+        assert rpc.parse_stale_epoch(str(ei.value)) == 2
+        assert gcs.call("kv_get", "fo:replay", timeout=10) is None
+        io.call_soon(conn._do_close)
+
+        # and the managed path turns that refusal into StaleEpochError
+        # when recovery is exhausted — importable, typed, catchable
+        assert issubclass(StaleEpochError, ray_tpu.exceptions.RayTpuError)
+
+        # no false node deaths: the head raylet re-registered
+        deadline = time.monotonic() + 20
+        while True:
+            nodes = ray_tpu.nodes()
+            if nodes and all(n.get("alive", True) for n in nodes):
+                break
+            assert time.monotonic() < deadline, f"nodes not back: {nodes}"
+            time.sleep(0.3)
+
+        # driver functional against the promoted primary
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41), timeout=60) == 42
+        for cli in clis:
+            cli.close()
+    finally:
+        c.shutdown()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------- soak
+
+
+def _wait_epoch(gcs, epoch, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            st = gcs.call("internal_state", None, timeout=10)
+            if st["epoch"] >= epoch:
+                return st
+        except Exception:
+            pass
+        assert time.monotonic() < deadline, f"epoch {epoch} never served"
+        time.sleep(0.3)
+
+
+@pytest.mark.slow
+def test_failover_soak_partition_split_brain_and_rearm():
+    """Soak the whole protocol: (1) a seeded chaos mute silences the
+    primary's outbound (it stays ALIVE — the nastiest partition shape)
+    -> the standby promotes; (2) when the window heals, the old primary
+    probes the promoted peer and fences itself out (exit 3 split-brain
+    rejection); (3) autoscaler heal intents journaled before the
+    partition survive promotion; (4) a re-armed standby at the old
+    primary's address carries a SECOND failover (epoch 3) with zero
+    acked loss across both."""
+    spec = chaos.make_spec(
+        seed=11, mutes=chaos.gcs_partition_mutes(at=4.0, duration=5.0))
+    with network_chaos(spec):
+        c = Cluster(
+            initialize_head=True,
+            head_node_args={"resources": {"CPU": 2}},
+            system_config={
+                "gcs_storage_backend": "file",
+                "gcs_standby": True,
+                "gcs_snapshot_interval_s": 3600.0,
+                "gcs_failover_grace_s": 1.0,
+            },
+            use_tcp=True,
+        )
+        c.connect()
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            gcs = global_worker.core_worker.gcs
+            # a gang-heal intent in flight before any fault
+            assert gcs.call(
+                "autoscaler_intent_put",
+                ["gang:soak", {"shape": [2, 2], "reason": "heal"}],
+                timeout=10,
+            )["ok"]
+
+            acked = []
+            stop = threading.Event()
+            cli = rpc.Client.connect(c._impl.gcs_addr, name="soak-mut")
+
+            def put():
+                k = 0
+                while not stop.is_set():
+                    try:
+                        if cli.call("kv_put", [f"soak:{k}", b"d", True],
+                                    timeout=25):
+                            acked.append(k)
+                    except Exception:
+                        pass
+                    k += 1
+
+            t = threading.Thread(target=put)
+            t.start()
+
+            # phase 1: the mute window (starts 4s after spec epoch)
+            # partitions the live primary -> promotion to epoch 2
+            _wait_epoch(gcs, 2, timeout=40)
+            # phase 2: window heals; the old primary (still running)
+            # must fence itself against the promoted peer
+            deadline = time.monotonic() + 30
+            while c._impl.gcs_proc.poll() is None:
+                assert time.monotonic() < deadline, \
+                    "resurrected/partitioned old primary never fenced"
+                time.sleep(0.3)
+            assert c._impl.gcs_proc.returncode == 3
+
+            # phase 3: heal intents survived promotion
+            table = gcs.call("autoscaler_intent_table", None, timeout=20)
+            assert table.get("gang:soak", {}).get("shape") == [2, 2]
+
+            # phase 4: re-arm at the old primary's (now free) address,
+            # SIGKILL the promoted primary -> second failover
+            old_standby = c._impl.standby_proc
+            c._impl.start_gcs_standby(
+                sock_addr=c._impl.gcs_primary_addr,
+                primary_addr=c._impl._standby_addr,
+            )
+            time.sleep(2.0)  # let it sync
+            old_standby.kill()
+            old_standby.wait()
+            st = _wait_epoch(gcs, 3, timeout=40)
+            assert st["epoch"] == 3
+
+            stop.set()
+            t.join(timeout=60)
+            assert len(acked) > 50
+            lost = [k for k in acked
+                    if gcs.call("kv_get", f"soak:{k}", timeout=15) != b"d"]
+            assert not lost, f"{len(lost)} acked lost across 2 failovers"
+            table = gcs.call("autoscaler_intent_table", None, timeout=20)
+            assert "gang:soak" in table
+            cli.close()
+        finally:
+            c.shutdown()
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass

@@ -108,16 +108,16 @@ def test_autoscaler_scales_up_and_down():
             node_resources={"CPU": 2},
             min_workers=0,
             max_workers=2,
-            idle_timeout_s=3.0,
+            idle_timeout_s=2.0,
             poll_interval_s=0.5,
         ).start()
 
         @ray_tpu.remote(num_cpus=1)
         def hold(i):
-            time.sleep(4)
+            time.sleep(2.5)
             return i
 
-        # 5 CPU-seconds of demand vs a 1-CPU head: the scaler must add nodes
+        # 12.5 CPU-seconds of demand vs a 1-CPU head: the scaler must add nodes
         refs = [hold.remote(i) for i in range(5)]
         out = ray_tpu.get(refs, timeout=180)
         assert sorted(out) == list(range(5))

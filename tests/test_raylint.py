@@ -363,6 +363,36 @@ def test_r9_covers_heal_and_provisioning_modules(tmp_path):
     assert [f for f in findings if f.rule == "R9"] == []
 
 
+def test_r9_covers_gcs_standby_module(tmp_path):
+    """R9 covers the warm-standby/promotion module (r16): during a
+    failover the standby's log is often the ONLY diagnostic for a
+    cluster-wide outage, so a sync/ship/promotion raise that drops its
+    chain (the refused journal_sync reply, the socket error under the
+    gap) is exactly the unattributable-failure class R9 exists for."""
+    bad = textwrap.dedent(
+        """
+        async def _sync(self):
+            try:
+                return await conn.call_async("journal_sync", {})
+            except OSError:
+                raise RuntimeError("sync to primary failed")
+        """
+    )
+    good = textwrap.dedent(
+        """
+        async def _sync(self):
+            try:
+                return await conn.call_async("journal_sync", {})
+            except OSError as e:
+                raise RuntimeError("sync to primary failed") from e
+        """
+    )
+    findings, _ = lint_source(bad, "_private/gcs_standby.py")
+    assert any(f.rule == "R9" for f in findings)
+    findings, _ = lint_source(good, "_private/gcs_standby.py")
+    assert [f for f in findings if f.rule == "R9"] == []
+
+
 def test_r4_covers_serve_router_randomness():
     """R4 extends to serve/router.py (r9): replica picks are routing
     decisions a replayed chaos schedule must meet again, so the router

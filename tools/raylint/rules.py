@@ -597,6 +597,10 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
     # serve/) plus the elastic compute plane — mesh/ and the
     # provisioning client files, whose error chains feed heal-loop
     # attribution (a blank timeout there is an unattributable MTTR).
+    # r16: the standby/promotion module (_private/gcs_standby.py) rides
+    # the in_private arm — failover-path raises (sync refusal, ship
+    # gaps, promotion) must chain, or an unattributable error lands in
+    # the one log read during an outage.
     in_r9_scope = (
         in_private
         or {"serve", "mesh"} & set(posix.split("/"))
