@@ -774,22 +774,38 @@ def main():
     try:
         from tools.raylint import lint_paths
 
+        _lint_t0 = time.perf_counter()
         _lint = lint_paths(
             ["ray_tpu", "tests", "tools"],
             root=os.path.dirname(os.path.abspath(__file__)),
         )
+        _lint_wall_s = time.perf_counter() - _lint_t0
         # unused suppressions (S1) are real findings and already in the
         # list; parse errors are reported separately but gate identically
         raylint_findings = len(_lint["findings"]) + len(_lint["errors"])
+        # contract rules (raylint 3.0 third pass) broken out so a
+        # wire-surface regression — unknown method, acked-before-journal
+        # mutation, knob drift, or contracts.lock.json drift (reported
+        # as R10) — is visible at a glance in the BENCH trajectory
+        _contract = {
+            r: _lint["counts"].get(r, 0) for r in ("R10", "R11", "R12")
+        }
         raylint_detail = {
             "findings": len(_lint["findings"]),
             "parse_errors": len(_lint["errors"]),
             "suppressed": _lint["suppressed"],
             "unused_suppressions": _lint["unused_suppressions"],
             "by_rule": _lint["counts"],
+            "contract_findings": sum(_contract.values()),
+            # acceptance bound: full-tree analysis (all three passes)
+            # must stay under 5s on an idle machine — recorded, not
+            # hard-gated, because bench runs share the box with the
+            # perf workload and wall time is load-sensitive
+            "wall_s": round(_lint_wall_s, 3),
         }
     except Exception as e:  # a broken linter must fail loudly, not pass
         raylint_findings = -1
+        _lint_wall_s = None
         raylint_detail = {"error": str(e)[:160]}
     if raylint_findings != 0:
         violations.append({

@@ -79,13 +79,9 @@ _d = GLOBAL_CONFIG.define
 # --- core ---
 _d("object_store_memory_bytes", int, 2 * 1024**3)
 _d("inline_object_max_bytes", int, 100 * 1024)  # small objects ride in RPCs
-_d("worker_register_timeout_s", float, 60.0)
-_d("task_retry_delay_ms", int, 0)
 _d("default_max_retries", int, 3)
-_d("actor_default_max_restarts", int, 0)
 _d("health_check_period_ms", int, 1000)
 _d("health_check_timeout_ms", int, 10000)
-_d("num_heartbeats_timeout", int, 30)
 _d("lineage_pinning_enabled", bool, True)
 # streaming generators: executor pauses when this many reported yields are
 # unconsumed by the caller (parity: reference
@@ -94,10 +90,7 @@ _d("streaming_generator_backpressure_items", int, 8)
 # cross-process span propagation in task metadata (reference
 # RAY_TRACING_ENABLED / tracing_helper.py:322)
 _d("tracing_enabled", bool, False)
-_d("max_lineage_bytes", int, 1024**3)
 _d("prestart_workers", bool, True)
-_d("worker_pool_min_idle", int, 0)
-_d("scheduler_spread_threshold", float, 0.5)
 _d("infeasible_task_grace_s", float, 30.0)
 _d("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
 # outbound chunk-serve concurrency per raylet: bounds chunk payloads
@@ -203,7 +196,6 @@ _d("conduit_ev_high_water_mb", int, 512)
 _d("max_lease_requests_in_flight", int, 32)
 _d("memory_monitor_refresh_ms", int, 250)
 _d("memory_usage_threshold", float, 0.95)
-_d("event_stats_enabled", bool, True)
 _d("task_events_enabled", bool, True)
 _d("metrics_report_interval_ms", int, 2000)
 _d("object_spilling_enabled", bool, True)

@@ -2440,7 +2440,7 @@ class CoreWorker:
             return
 
     # ----- streaming actor push (round 4 data plane) -----
-    # One NOTIFY frame per call out ("push_task_n"), one NOTIFY frame per
+    # One NOTIFY frame per call out ("push_task_c"/"push_task_p"), one NOTIFY frame per
     # completion back ("task_done"), handled INLINE in the read loop — no
     # per-call asyncio future on either side. Parity: the role of the
     # reference's C++ direct actor transport (task_manager + actor submit
@@ -2720,8 +2720,7 @@ class CoreWorker:
         out-of-order staging. Returns False to route to the loop."""
         if method == "push_task" and kind == 0:  # rpc._REQUEST
             streamed = False
-        elif method in ("push_task_c", "push_task_n",
-                        "push_task_p") and kind == 3:
+        elif method in ("push_task_c", "push_task_p") and kind == 3:
             streamed = True  # rpc._NOTIFY
         else:
             return False
@@ -2797,18 +2796,15 @@ class CoreWorker:
         actors skip the gate."""
         return await self._pushed_task_reply(conn, TaskSpec.from_wire(spec_wire))
 
-    async def rpc_push_task_n(self, conn, spec_wire: Dict):
-        """Streamed (notify) push: same execution path as rpc_push_task,
-        completion sent back as a ``task_done`` notify keyed by task id
-        (no request/reply future on either side). This is the asyncio-
-        transport fallback; conduit workers intercept the frame on the
-        reaper thread (_conduit_fast_push) and never reach here."""
-        spec = TaskSpec.from_wire(spec_wire)
-        reply = await self._pushed_task_reply(conn, spec)
-        await conn.notify_async("task_done", [spec.task_id, reply])
-
     async def rpc_push_task_c(self, conn, wire: List):
-        """Slim-wire variant of rpc_push_task_n (asyncio fallback)."""
+        """Streamed (notify) slim-wire push: same execution path as
+        rpc_push_task, completion sent back as a ``task_done`` notify
+        keyed by task id (no request/reply future on either side). This
+        is the asyncio-transport fallback; conduit workers intercept the
+        frame on the reaper thread (_conduit_fast_push) and never reach
+        here. (The full-wire notify variant ``push_task_n`` was dead wire
+        surface — every streamed sender encodes slim — and was removed
+        by the R10 contract pass.)"""
         spec = _spec_from_slim(wire)
         reply = await self._pushed_task_reply(conn, spec)
         await conn.notify_async("task_done", [spec.task_id, reply])

@@ -1649,7 +1649,10 @@ class GcsServer:
         rec.death_cause = reason
         if rec.name:
             self.named_actors.pop(rec.name, None)
-        self._journal_actor(rec)
+        # Durable-at-ack (R11): the DEAD record must be flushed before any
+        # rpc_ caller replies, else a kill acked to the client can be
+        # forgotten by a journal-replayed GCS (the actor resurrects).
+        await self._journal_wait(self._journal_actor(rec))
         self._publish("actors", [rec.to_wire()])
 
     async def _on_actor_death(self, rec: ActorRecord, reason: str):
@@ -1661,7 +1664,9 @@ class GcsServer:
             rec.num_restarts += 1
             rec.state = RESTARTING
             rec.address = None
-            self._journal_actor(rec)
+            # Durable-at-ack (R11): a restart decision that is acked but
+            # lost on failover double-spends restarts_left after replay.
+            await self._journal_wait(self._journal_actor(rec))
             self._publish("actors", [rec.to_wire()])
             logger.info("restarting actor %s (%d restarts)",
                         rec.actor_id.hex()[:12], rec.num_restarts)
@@ -1815,7 +1820,7 @@ class GcsServer:
         rec.state = PG_REMOVED
         nodes = {n for n in rec.assignment if n is not None}
         rec.assignment = [None] * len(rec.bundles)
-        self._journal_pg(rec)
+        fut = self._journal_pg(rec)
         for nid in nodes:
             raylet = self._raylet_clients.get(nid)
             if raylet is not None and not raylet.closed:
@@ -1825,6 +1830,9 @@ class GcsServer:
                 except Exception:
                     pass
         self._publish("placement_groups", [rec.to_wire()])
+        # Durable-at-ack (R11): flush overlaps the release round-trips
+        # above; the ack must not outrun the PG_REMOVED journal record.
+        await self._journal_wait(fut)
         return True
 
     def _plan_bundles(self, rec: PgRecord) -> Optional[List[bytes]]:

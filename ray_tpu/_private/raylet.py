@@ -1806,6 +1806,18 @@ class Raylet:
             gone = self.spilled.pop(oid.binary(), None)
             if gone is not None:
                 self.spilled_bytes = max(0, self.spilled_bytes - gone[1])
+            # The spill file is gone (operator wiped the spill dir, or the
+            # bucket expired it): this node no longer holds a copy, so
+            # retract it from the GCS object directory — otherwise pullers
+            # keep targeting a location that can never serve, masking the
+            # true ObjectLost until every other copy is also gone.
+            try:
+                await self.gcs.call_async(
+                    "remove_object_location", [oid.binary(), self.node_id]
+                )
+            except Exception:
+                logger.warning("location retraction for %s failed",
+                               oid.hex()[:12])
             return False
         buf = await self._create_local_with_spill(oid, len(data))
         if buf is None:

@@ -58,9 +58,19 @@ def _api(path: str):
     if path == "placement_groups":
         return state.list_placement_groups()
     if path == "jobs":
+        from ray_tpu._private.worker import require_connected
         from ray_tpu.job_submission import JobSubmissionClient
 
-        return JobSubmissionClient().list_jobs()
+        # Both job surfaces (parity: reference dashboard job view): every
+        # connected driver registers in the GCS job table
+        # (rpc_register_job -> rpc_get_jobs); submission-API jobs
+        # additionally keep a jobsub:<id> KV record with entrypoint,
+        # status, and log path.
+        drivers = require_connected().gcs.call("get_jobs", None, timeout=10)
+        return {
+            "drivers": drivers,
+            "submissions": JobSubmissionClient().list_jobs(),
+        }
     if path == "metrics":
         from ray_tpu.util import metrics
 

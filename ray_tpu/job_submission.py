@@ -206,6 +206,20 @@ class JobSubmissionClient:
             self._supervisor(job_id).stop.remote(), timeout=60
         )
 
+    def delete_job(self, job_id: str) -> bool:
+        """Delete a terminal job's status record from the GCS KV (parity:
+        JobSubmissionClient.delete_job). Refuses while the job is still
+        PENDING/RUNNING — ``stop_job`` it first; deleting a live record
+        would orphan the supervisor's next status write into a fresh
+        half-record."""
+        status = self.get_job_status(job_id)
+        if status not in (SUCCEEDED, FAILED, STOPPED):
+            raise RuntimeError(
+                f"cannot delete job {job_id!r} in state {status}; "
+                f"stop_job() it first"
+            )
+        return bool(self._gcs.call("kv_del", f"jobsub:{job_id}"))
+
     def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

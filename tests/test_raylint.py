@@ -180,6 +180,69 @@ CORPUS = {
                 raise RuntimeError("snapshot load failed") from e
         """,
     ),
+    # Contract rules (pass 3): the registry is rebuilt per lint_source
+    # call, so each fixture is a self-contained wire surface.
+    "R10": (
+        "_private/control.py",
+        # "putt" resolves to nothing (typo'd caller) and rpc_put has no
+        # caller (dead handler) — both prongs of the method contract.
+        """
+        class GcsServer:
+            async def rpc_put(self, data):
+                return True
+            async def tick(self):
+                await self.gcs.call_async("putt", [1])
+        """,
+        """
+        class GcsServer:
+            async def rpc_put(self, data):
+                return True
+            async def tick(self):
+                await self.gcs.call_async("put", [1])
+        """,
+    ),
+    "R11": (
+        "_private/control.py",
+        # replies (return True) after buffering a journal record with
+        # no awaited _journal_wait — the durable-at-ack violation
+        """
+        class GcsServer:
+            def handler_table(self):
+                return rpc.handler_table(self)
+            async def rpc_mark(self, data):
+                self._journal({"k": data})
+                return True
+            async def tick(self):
+                await self.gcs.call_async("mark", [1])
+        """,
+        """
+        class GcsServer:
+            def handler_table(self):
+                return rpc.handler_table(self)
+            async def rpc_mark(self, data):
+                fut = self._journal({"k": data})
+                await self._journal_wait(fut)
+                return True
+            async def tick(self):
+                await self.gcs.call_async("mark", [1])
+        """,
+    ),
+    "R12": (
+        "_private/config.py",
+        # defined, never read anywhere -> dead knob
+        """
+        def _d(name, default):
+            GLOBAL_CONFIG.define(name, default)
+        _d("ghost_knob_ms", 250)
+        """,
+        """
+        def _d(name, default):
+            GLOBAL_CONFIG.define(name, default)
+        _d("live_knob_ms", 250)
+        def poll():
+            return GLOBAL_CONFIG.get("live_knob_ms")
+        """,
+    ),
 }
 
 
@@ -743,7 +806,177 @@ def test_sarif_output_and_exit_code(tmp_path):
     assert run["tool"]["driver"]["name"] == "raylint"
     assert any(r["ruleId"] == "R1" for r in run["results"])
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"R7", "R8", "R9", "S1"} <= rule_ids
+    assert {"R7", "R8", "R9", "R10", "R11", "R12", "S1"} <= rule_ids
+
+
+# ------------------------------------------------- contract rules (pass 3)
+
+
+def test_r10_bad_fixture_names_both_prongs():
+    """The R10 corpus fixture is double-dirty by design: the typo'd
+    caller fires unknown-method AND the orphaned rpc_put fires
+    dead-handler — assert both prongs individually so neither can
+    silently stop firing."""
+    findings, _ = _lint_snippet("R10", CORPUS["R10"][1])
+    msgs = [f.message for f in findings if f.rule == "R10"]
+    assert any("unknown wire method" in m for m in msgs), msgs
+    assert any("dead handler rpc_put" in m for m in msgs), msgs
+
+
+def test_r10_cross_transport_arity_skew():
+    """Handler unpacks exactly 2 payload elements; a caller ships 3.
+    The skew is invisible to either transport alone — only the
+    cross-checked registry sees both ends of the wire."""
+    findings, _ = lint_source(textwrap.dedent("""
+        class Raylet:
+            async def rpc_span(self, conn, data):
+                lo, hi = data
+                return hi
+            async def tick(self):
+                await self.raylet.call_async("span", [1, 2, 3])
+        """), "_private/control.py")
+    assert any(f.rule == "R10" and "arity skew" in f.message
+               for f in findings), [f.as_dict() for f in findings]
+    # matching payload length is clean
+    findings, _ = lint_source(textwrap.dedent("""
+        class Raylet:
+            async def rpc_span(self, conn, data):
+                lo, hi = data
+                return hi
+            async def tick(self):
+                await self.raylet.call_async("span", [1, 2])
+        """), "_private/control.py")
+    assert findings == [], [f.as_dict() for f in findings]
+
+
+def test_r10_plane_mismatch():
+    """A method that only exists on the raylet plane, sent down a
+    ``self.gcs`` connection. The hint only fires when the receiver
+    token names a real plane that is present in the tree."""
+    findings, _ = lint_source(textwrap.dedent("""
+        class GcsServer:
+            async def rpc_ping(self, data):
+                return True
+        class Raylet:
+            async def rpc_span(self, conn, data):
+                return data
+            async def tick(self):
+                await self.gcs.call_async("span", [1])
+                await self.gcs.call_async("ping", [1])
+                await self.raylet.call_async("span", [1])
+        """), "_private/control.py")
+    plane = [f for f in findings
+             if f.rule == "R10" and "no handler exists on the gcs plane"
+             in f.message]
+    assert len(plane) == 1, [f.as_dict() for f in findings]
+    assert plane[0].line == 9
+
+
+def test_r11_journaling_handler_not_dedup_reachable():
+    """A journaling handler on a class never served via
+    rpc.handler_table: a replayed request double-applies the
+    mutation even if the reply ordering is right."""
+    findings, _ = lint_source(textwrap.dedent("""
+        class GcsServer:
+            async def rpc_mark(self, data):
+                fut = self._journal({"k": data})
+                await self._journal_wait(fut)
+                return True
+            async def tick(self):
+                await self.gcs.call_async("mark", [1])
+        """), "_private/control.py")
+    assert any(f.rule == "R11" and "not dedup-reachable" in f.message
+               for f in findings), [f.as_dict() for f in findings]
+
+
+def test_r12_phantom_read():
+    """A GLOBAL_CONFIG.get of a name config.py never defines is an
+    AttributeError waiting for the first caller to hit that path."""
+    findings, _ = lint_source(textwrap.dedent("""
+        def _d(name, default):
+            GLOBAL_CONFIG.define(name, default)
+        _d("live_knob_ms", 250)
+        def poll():
+            GLOBAL_CONFIG.get("live_knob_ms")
+            return GLOBAL_CONFIG.get("speling_eror_ms")
+        """), "_private/config.py")
+    phantom = [f for f in findings
+               if f.rule == "R12" and "phantom config read" in f.message]
+    assert len(phantom) == 1, [f.as_dict() for f in findings]
+    assert "speling_eror_ms" in phantom[0].message
+
+
+def test_r12_undocumented_knob(tmp_path):
+    """The doc prong only arms under lint_paths with a root that holds
+    a DESIGN.md — defined + read but absent from the doc of record is
+    a finding; naming it in DESIGN.md clears it."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    (priv / "config.py").write_text(textwrap.dedent("""
+        def _d(name, default):
+            GLOBAL_CONFIG.define(name, default)
+        _d("orphan_knob_s", 5)
+        """))
+    (priv / "svc.py").write_text(textwrap.dedent("""
+        from ._private.config import GLOBAL_CONFIG
+        def poll():
+            return GLOBAL_CONFIG.get("orphan_knob_s")
+        """))
+    (tmp_path / "DESIGN.md").write_text("# design\nno knobs here\n")
+    report = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert any(f["rule"] == "R12" and "undocumented knob" in f["message"]
+               for f in report["findings"]), report["findings"]
+    (tmp_path / "DESIGN.md").write_text(
+        "# design\n`orphan_knob_s` — poll period, default 5.\n")
+    report = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert report["findings"] == [], report["findings"]
+
+
+def test_contracts_lock_schema(tmp_path):
+    """--contracts emits the stable-sorted wire registry: schema-locked
+    top-level keys, deterministic byte-for-byte across runs, and the
+    checked-in repo artifact covers every serving plane."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    (priv / "control.py").write_text(textwrap.dedent("""
+        class GcsServer:
+            async def rpc_put(self, data):
+                return True
+            async def tick(self):
+                await self.gcs.call_async("put", [1])
+        """))
+
+    def emit(out):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.raylint",
+             "--contracts", str(out), str(tmp_path)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return out.read_bytes()
+
+    a = emit(tmp_path / "a.json")
+    b = emit(tmp_path / "b.json")
+    assert a == b, "lock emission must be deterministic"
+    lock = json.loads(a)
+    assert set(lock) == {"version", "planes", "send_sites",
+                         "transports", "knobs"}
+    assert lock["version"] == 1
+    assert "put" in lock["planes"]["gcs"]["handlers"]
+    (site,) = lock["send_sites"]
+    assert set(site) == {"api", "dedup", "embedded", "file", "methods",
+                         "nargs"}
+
+    # the checked-in artifact has the same schema and covers all four
+    # serving planes with a non-trivial handler surface
+    repo_lock = json.loads(
+        open("/root/repo/tools/raylint/contracts.lock.json").read())
+    assert set(repo_lock) == set(lock)
+    assert set(repo_lock["planes"]) == {"gcs", "raylet", "worker",
+                                        "standby"}
+    for plane in ("gcs", "raylet", "worker"):
+        assert repo_lock["planes"][plane]["handlers"], plane
+    assert all("read" in v for v in repo_lock["knobs"].values())
 
 
 def test_repo_is_raylint_clean():

@@ -1,4 +1,4 @@
-"""raylint 2.0 — repo-native static invariant checker for the async
+"""raylint 3.0 — repo-native static invariant checker for the async
 control plane (stdlib ``ast`` only, no dependencies).
 
 PRs 1–2 introduced invariants that nothing enforced mechanically;
@@ -11,9 +11,16 @@ handling); pass 2 runs flow-aware rules over it, so call chains that
 cross functions and modules are visible (a sync helper that calls
 ``time.sleep`` two hops below an async handler, an ``await`` under a
 held lock that resolves into the chaos-faulted wire layer, an
-``except`` that re-raises without ``from``).  Findings are enforced as
-tier-1 tests (``tests/test_raylint.py``) and a bench-gate metric
-(``bench.py``).
+``except`` that re-raises without ``from``).  r17 added a **third
+pass** (``tools/raylint/contracts.py``): a wire-contract extractor
+that builds a machine-readable registry of every ``rpc_`` handler
+(plane, arity, journaling, dedup reachability) and every string-named
+send site in both transports, then verifies it — unknown methods,
+dead handlers, arity skew (R10), acked-before-durable mutations (R11)
+and config-knob drift (R12) are findings, and the registry itself is
+a reviewable lock artifact (``tools/raylint/contracts.lock.json``)
+whose drift fails the gate.  Findings are enforced as tier-1 tests
+(``tests/test_raylint.py``) and a bench-gate metric (``bench.py``).
 
 Usage::
 
@@ -23,6 +30,8 @@ Usage::
                                                    # rc 1 on findings -> pre-commit/CI entry point
     python -m tools.raylint --changed HEAD ray_tpu # only files touched vs a git ref
                                                    # (the call graph still spans the whole tree)
+    python -m tools.raylint --contracts tools/raylint/contracts.lock.json \\
+        ray_tpu tests tools                        # regenerate the wire-surface lock
 
 Suppress a deliberate finding on its line (or the line above, or the
 enclosing ``def`` line) with a reason::
@@ -44,6 +53,9 @@ R6 swallowed-cancellation  bare except / swallowed CancelledError in async code
 R7 transitive-blocking     sync helper chains under async/_private defs that reach blocking calls (call graph)
 R8 lock-across-await       await under a held lock resolving into the chaos-faulted wire layer (call graph)
 R9 typed-error-chain       cause-dropping ``raise`` in except / untyped TimeoutError in control-plane modules
+R10 method-contract        call-site method strings must resolve to a live handler with compatible arity (contract registry)
+R11 mutation-durability    journaling handlers must be dedup-reachable and await _journal_wait before replying
+R12 knob-drift             config knobs must be defined, read, and documented in DESIGN.md — no drift in any direction
 S1 unused-suppression      a ``# raylint: disable`` that silences nothing
 """
 

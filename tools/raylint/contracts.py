@@ -1,0 +1,1003 @@
+"""raylint pass 3 (r17): the wire-contract registry + rules R10–R12.
+
+The control and data planes dispatch every RPC by *string name*
+(``cli.call("create_actor", ...)`` resolves to ``async def
+rpc_create_actor`` on the serving class via ``rpc.handler_table``),
+across two frame-compatible transports — a contract that until this
+pass existed only as convention.  r16 showed the failure mode: epoch
+fencing was silently inert on the conduit transport until hand-threaded
+through both ``_handle`` paths, a cross-cutting wire property no
+function-local or call-graph rule (R1–R9) can see.  This module makes
+the contract explicit: it extracts the full wire surface from the
+parsed trees into a machine-readable registry, verifies it (R10/R11),
+and does the same for the config-knob surface (R12).
+
+Extraction (one walk per module, reusing the pass-1 ``ProjectIndex``
+for symbol/decoder/forwarder resolution):
+
+* **Handlers** — every ``rpc_<name>`` method on a class (the serving
+  planes ``GcsServer``/``Raylet``/``CoreWorker``/``GcsStandby``, plus
+  any fixture class), with wire arity recovered from how the ``data``
+  parameter is consumed (exact tuple unpack; constant subscripts, with
+  ``len(data) > k``-guarded indices treated as optional; one resolver
+  hop into project decoders the payload is handed to whole), whether
+  the handler buffers a journal record (``self._journal`` /
+  ``self._journal_actor``, directly or one ``self.``-method hop down)
+  and awaits ``self._journal_wait`` before replying, and whether its
+  class is served through ``rpc.handler_table`` (→ dedup-reachable via
+  ``rpc.run_idempotent``).  Notify-dispatched handlers
+  (``conn.sync_notify["task_done"] = ...`` / ``sync_notify_fast`` /
+  ``raw_notify`` registrations) and reaper-thread fast-dispatch method
+  strings (``method == "push_task_c"`` comparisons) register as
+  handlers too — they are receivers, just not ``rpc_``-prefixed ones.
+* **Send sites** — ``.call(...)`` / ``.call_async`` / ``.notify`` /
+  ``.notify_async`` / ``.send_notify_corked`` / ``.cd_push_batch`` /
+  ``.send_frame`` calls whose method argument carries a constant
+  string (ternaries of constants contribute both branches), plus one
+  level of *dynamic forwarder* resolution: a function that forwards one
+  of its own parameters into a send site's method slot
+  (``mesh._gcs_call``, ``dashboard._raylet_call``,
+  ``raylet._gcs_call_replayed``) lifts its callers' constant method
+  strings into send sites.  Module-level string constants that parse as
+  Python (the ``ray_perf`` subprocess bench scripts) are scanned as
+  embedded scripts: their sends count as callers (so ``ping`` is not
+  "dead"), but never raise findings.
+* **Knobs** — every ``_d("name", ...)`` / ``GLOBAL_CONFIG.define``
+  call in a ``config.py``, every read (``GLOBAL_CONFIG.<name>``
+  attribute through import aliases, ``GLOBAL_CONFIG.get("name")``, and
+  constant calls into config forwarders whose parameter lands in a
+  ``.get``), and DESIGN.md mentions.
+
+Rules over the registry (findings attach to the offending file, so the
+normal suppression protocol applies):
+
+R10 method-contract      a call-site method string must resolve to a
+                         handler (on the hinted plane when the receiver
+                         names one) with compatible arity; handlers no
+                         send site, embedded script, or call-argument
+                         string references are dead wire surface.
+R11 mutation-durability  a journaling handler must be dedup-reachable
+                         (its class served via ``rpc.handler_table``)
+                         and must await ``self._journal_wait`` between
+                         buffering and every subsequent value reply
+                         (acked-before-durable); a ``dedup=False`` call
+                         to a journaling handler whose docstring does
+                         not declare application-level idempotence is
+                         replayable-non-idempotent.
+R12 knob-drift           every defined knob is read somewhere (strong
+                         read, or string reference outside config.py),
+                         every ``GLOBAL_CONFIG`` read is defined, and —
+                         when a DESIGN.md exists under the lint root —
+                         every knob is documented in it.
+
+Scoping: R10/R11 findings are skipped for files under ``tests/`` or
+``examples/`` path segments (their fixture servers use throwaway method
+strings by design) and for embedded scripts, but handlers and callers
+are *collected* from everywhere, so a handler whose only caller is a
+test or an embedded bench is still live.  R12 activates only when the
+linted set contains a ``config.py`` defining knobs.  Like ``--changed``,
+the contract rules assume the documented root set (``ray_tpu tests
+tools``): a partial run sees a partial wire surface and may over-report
+dead handlers/knobs.
+
+The registry itself is the reviewable artifact: ``--contracts out.json``
+emits it stable-sorted and *without line numbers* (so unrelated edits
+do not churn the diff); ``tools/raylint/contracts.lock.json`` is that
+output checked in, and when the linted set includes this module a
+mismatch between lock and freshly extracted surface is an R10 finding
+(fix: ``python -m tools.raylint --contracts
+tools/raylint/contracts.lock.json ray_tpu tests tools``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import Finding
+from tools.raylint.graph import ProjectIndex, dotted_name
+
+#: canonical plane names for the known serving classes; anything else
+#: keys by its lowercased class name (fixture trees stay self-coherent).
+_PLANE_NAMES = {
+    "GcsServer": "gcs",
+    "Raylet": "raylet",
+    "CoreWorker": "worker",
+    "GcsStandby": "standby",
+}
+
+#: send APIs -> positional index of the method argument.
+_SEND_APIS = {
+    "call": 0,
+    "call_async": 0,
+    "notify": 0,
+    "notify_async": 0,
+    "send_notify_corked": 0,
+    "cd_push_batch": 0,
+    "send_frame": 2,
+}
+
+#: notify dispatch tables: a ``conn.<table>["m"] = fn`` assignment
+#: registers ``m`` as a handler on the assigning class's plane.
+_NOTIFY_TABLES = frozenset({"sync_notify", "sync_notify_fast",
+                            "raw_notify"})
+
+#: Config methods / internals that are never knob reads.
+_CONFIG_API = frozenset({"define", "get", "initialize", "dump", "load"})
+
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+#: Planes whose names may appear in receiver text as a routing hint
+#: (``self.gcs.call(...)``).  Test doubles register ad-hoc planes; only
+#: the real four are trustworthy enough to flag a plane mismatch on.
+_REAL_PLANES = frozenset(_PLANE_NAMES.values())
+
+_TOKEN_RE = re.compile(r"[^a-z0-9]+")
+
+_LOCK_RELPATH = "tools/raylint/contracts.lock.json"
+_SELF_RELPATH = "tools/raylint/contracts.py"
+
+
+def _is_test_path(path: str) -> bool:
+    segs = path.replace(os.sep, "/").split("/")
+    return bool({"tests", "examples"} & set(segs[:-1])) or (
+        segs[-1].startswith("test_"))
+
+
+def _const_strings(node: ast.expr) -> List[str]:
+    """Constant strings an expression can evaluate to: a Constant gives
+    one, an IfExp of constants gives both branches (the
+    ``"add_borrower" if add else "remove_borrower"`` shape)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _const_strings(node.body) + _const_strings(node.orelse)
+    return []
+
+
+class Handler:
+    """One wire-dispatched receiver (rpc_ method, notify registration,
+    or reaper fast-dispatch method string)."""
+
+    __slots__ = ("method", "kind", "plane", "cls", "path", "lineno",
+                 "arity_exact", "arity_min", "payload", "journaling",
+                 "buffer_lines", "wait_lines", "value_return_lines",
+                 "doc_idempotent", "dedup_reachable")
+
+    def __init__(self, method: str, kind: str, plane: str, cls: str,
+                 path: str, lineno: int):
+        self.method = method
+        self.kind = kind              # "rpc" | "notify" | "fast"
+        self.plane = plane
+        self.cls = cls
+        self.path = path
+        self.lineno = lineno
+        self.arity_exact: Optional[int] = None
+        self.arity_min: int = 0
+        self.payload: str = "any"     # "seq" | "dict" | "any"
+        self.journaling = False
+        self.buffer_lines: List[int] = []
+        self.wait_lines: List[int] = []
+        self.value_return_lines: List[int] = []
+        self.doc_idempotent = False
+        self.dedup_reachable = False
+
+    def as_lock(self) -> dict:
+        return {
+            "kind": self.kind,
+            "arity": self.arity_exact,
+            "arity_min": self.arity_min,
+            "payload": self.payload,
+            "journaling": self.journaling,
+            "durable_at_ack": bool(self.journaling and self.wait_lines),
+            "dedup_reachable": self.dedup_reachable,
+            "idempotent": self.doc_idempotent,
+        }
+
+
+class SendSite:
+    """One call site that names a wire method with a constant string."""
+
+    __slots__ = ("path", "lineno", "col", "func_line", "api", "receiver",
+                 "methods", "nargs", "dedup", "embedded")
+
+    def __init__(self, path: str, lineno: int, col: int,
+                 func_line: Optional[int], api: str, receiver: str,
+                 methods: List[str], nargs: Optional[int],
+                 dedup: Optional[bool], embedded: bool):
+        self.path = path
+        self.lineno = lineno
+        self.col = col
+        self.func_line = func_line
+        self.api = api
+        self.receiver = receiver
+        self.methods = methods
+        self.nargs = nargs            # len() of a literal list/tuple payload
+        self.dedup = dedup            # explicit dedup= constant, if any
+        self.embedded = embedded
+
+    def as_lock(self) -> dict:
+        return {
+            "file": self.path.replace(os.sep, "/"),
+            "api": self.api,
+            "methods": sorted(self.methods),
+            "nargs": self.nargs,
+            "dedup": self.dedup,
+            "embedded": self.embedded,
+        }
+
+
+class _PendingCall:
+    """A project-resolvable call carrying constant-string or literal-seq
+    args — kept until forwarders are known, then lifted."""
+
+    __slots__ = ("path", "target", "lineno", "col", "func_line",
+                 "arg_strings", "arg_seq_lens", "embedded")
+
+    def __init__(self, path, target, lineno, col, func_line,
+                 arg_strings, arg_seq_lens, embedded):
+        self.path = path
+        self.target = target          # resolved project qname
+        self.lineno = lineno
+        self.col = col
+        self.func_line = func_line
+        self.arg_strings = arg_strings    # pos -> [str, ...]
+        self.arg_seq_lens = arg_seq_lens  # pos -> len of literal seq
+        self.embedded = embedded
+
+
+class ContractRegistry:
+    """The extracted wire + knob surface and the R10–R12 verdicts."""
+
+    def __init__(self, root: Optional[str]):
+        self.root = root
+        self.handlers: Dict[str, List[Handler]] = {}   # method -> [Handler]
+        self.planes: Dict[str, Tuple[str, str]] = {}   # plane -> (cls, path)
+        self.send_sites: List[SendSite] = []
+        self.knob_defs: Dict[str, Tuple[str, int]] = {}  # name -> (path, ln)
+        self.strong_reads: Dict[str, List[Tuple[str, int, int]]] = {}
+        self.weak_strings: Set[str] = set()   # call-arg strings, non-config
+        self.transports: Dict[str, Dict[str, bool]] = {}
+        self.lock_drift: Optional[str] = None
+        self._findings_by_file: Dict[str, List[Finding]] = {}
+        # ---- intermediates
+        self._paths: Set[str] = set()
+        self._table_classes: Set[str] = set()   # "path::Cls" handler_table'd
+        self._pending: List[_PendingCall] = []
+        self._cfg_forwarders: Set[Tuple[str, int]] = set()
+        self._send_forwarders: Dict[str, Tuple[int, str, str]] = {}
+        self._journal_direct: Dict[Tuple[str, str], Set[str]] = {}
+        self._journal_waits: Dict[Tuple[str, str], Set[str]] = {}
+        self._deferred: List[Tuple[Handler, ast.AST]] = []
+        self._index: Optional[ProjectIndex] = None
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(cls, files: List[Tuple[str, ast.AST]], index: ProjectIndex,
+              root: Optional[str]) -> "ContractRegistry":
+        reg = cls(root)
+        reg._index = index
+        for path, tree in files:
+            reg._paths.add(path.replace(os.sep, "/"))
+            reg._scan_module(path, tree, embedded=False)
+        reg._resolve()
+        reg._check()
+        return reg
+
+    # --------------------------------------------------- per-module scan
+
+    def _scan_module(self, path: str, tree: ast.AST, embedded: bool):
+        """One walk (explicit stack — no Python recursion per node),
+        tracking (class, function-stack) context the same way the pass-1
+        index builds qualnames, so forwarder lookups land on the right
+        FunctionInfo.  Journal facts (which methods buffer / await the
+        durability barrier) are folded into the same walk: a
+        ``self._journal*`` call anywhere inside a method is attributed
+        to the class-level enclosing method (``fn_stack[0]``) — the
+        one-hop lookup _analyze_handler needs."""
+        m = self._index.modules.get(path) if not embedded else None
+        base = os.path.basename(path)
+        is_config = base == "config.py"
+        iter_children = ast.iter_child_nodes
+        ClassDef, FunctionDef, AsyncFunctionDef = (
+            ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        Assign, Call, Attribute, Compare, Name = (
+            ast.Assign, ast.Call, ast.Attribute, ast.Compare, ast.Name)
+
+        stack = [(tree, None, ())]
+        while stack:
+            node, cls_name, fn_stack = stack.pop()
+            for child in iter_children(node):
+                t = type(child)
+                if t is ClassDef:
+                    self._scan_class(path, child, embedded)
+                    stack.append((child, child.name, ()))
+                    continue
+                if t is FunctionDef or t is AsyncFunctionDef:
+                    stack.append((child, cls_name, fn_stack + (child,)))
+                    continue
+                if t is Assign:
+                    self._scan_assign(path, child, cls_name, fn_stack,
+                                      embedded)
+                elif t is Call:
+                    f = child.func
+                    if (cls_name is not None and fn_stack
+                            and type(f) is Attribute
+                            and type(f.value) is Name
+                            and f.value.id == "self"):
+                        a = f.attr
+                        if a in ("_journal", "_journal_actor",
+                                 "_journal_pg"):
+                            self._journal_direct.setdefault(
+                                (path, cls_name), set()).add(
+                                fn_stack[0].name)
+                        elif a == "_journal_wait":
+                            self._journal_waits.setdefault(
+                                (path, cls_name), set()).add(
+                                fn_stack[0].name)
+                    self._scan_call(path, child, cls_name, fn_stack,
+                                    embedded, is_config, m)
+                elif t is Attribute:
+                    self._scan_attr_read(path, child, m)
+                elif t is Compare:
+                    self._scan_compare(path, child, cls_name)
+                stack.append((child, cls_name, fn_stack))
+
+        if base in ("rpc.py", "conduit_rpc.py") and not embedded:
+            self._scan_transport(base, tree)
+
+    def _scan_assign(self, path, node: ast.Assign, cls_name, fn_stack,
+                     embedded):
+        # notify-table registration: conn.sync_notify["m"] = fn
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and tgt.value.attr in _NOTIFY_TABLES
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)):
+                self._add_handler(Handler(
+                    tgt.slice.value, "notify", self._plane_for(cls_name),
+                    cls_name or "", path, node.lineno))
+        # embedded bench/fixture scripts: a long module-level string
+        # constant that parses as Python and touches the wire
+        if (not embedded and not fn_stack and cls_name is None
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and len(node.value.value) >= 200
+                and "\n" in node.value.value):
+            src = node.value.value
+            if "call" not in src and "notify" not in src:
+                return
+            try:
+                sub = ast.parse(src)
+            except (SyntaxError, ValueError):
+                return
+            ast.increment_lineno(sub, node.lineno - 1)
+            self._scan_module(path, sub, embedded=True)
+
+    def _scan_compare(self, path, node: ast.Compare, cls_name):
+        """Reaper fast-dispatch: ``method == "x"`` / ``method in
+        ("x", "y")`` inside a serving class registers x/y as handlers."""
+        if not (isinstance(node.left, ast.Name)
+                and node.left.id == "method" and cls_name):
+            return
+        for cmp in node.comparators:
+            elts = (cmp.elts if isinstance(cmp, (ast.Tuple, ast.List))
+                    else [cmp])
+            for el in elts:
+                if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str):
+                    self._add_handler(Handler(
+                        el.value, "fast", self._plane_for(cls_name),
+                        cls_name, path, el.lineno))
+
+    def _scan_class(self, path, node: ast.ClassDef, embedded):
+        """Register the class's rpc_ handlers; their body analysis is
+        deferred until the whole module's journal facts are in."""
+        plane = self._plane_for(node.name)
+        has_methods = False
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            has_methods = True
+            if not stmt.name.startswith("rpc_"):
+                continue
+            h = Handler(stmt.name[len("rpc_"):], "rpc", plane,
+                        node.name, path, stmt.lineno)
+            self._add_handler(h)
+            if not embedded:
+                self._deferred.append((h, stmt))
+        if has_methods:
+            self.planes.setdefault(plane, (node.name, path))
+
+    # ------------------------------------------------ handler deep-dive
+
+    def _analyze_handler(self, h: Handler, fn):
+        doc = (ast.get_docstring(fn) or "").lower()
+        h.doc_idempotent = "idempotent" in doc
+        key = (h.path, h.cls)
+        journal_direct = self._journal_direct.get(key, set())
+        journal_waits = self._journal_waits.get(key, set())
+        args = fn.args.args
+        data = args[2].arg if len(args) >= 3 else None
+        guarded: Set[int] = set()
+        max_idx = -1
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                self._note_len_guard(node, data, guarded)
+            elif isinstance(node, ast.Assign) and data is not None:
+                if (isinstance(node.value, ast.Name)
+                        and node.value.id == data
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Tuple)):
+                    elts = node.targets[0].elts
+                    if any(isinstance(e, ast.Starred) for e in elts):
+                        h.arity_min = max(h.arity_min, len(elts) - 1)
+                    else:
+                        h.arity_exact = len(elts)
+                    h.payload = "seq"
+            elif isinstance(node, ast.Subscript):
+                if (isinstance(node.value, ast.Name)
+                        and node.value.id == data):
+                    if (isinstance(node.slice, ast.Constant)
+                            and isinstance(node.slice.value, int)):
+                        idx = node.slice.value
+                        if idx >= 0 and idx not in guarded:
+                            max_idx = max(max_idx, idx)
+                        h.payload = "seq"
+                    elif (isinstance(node.slice, ast.Constant)
+                          and isinstance(node.slice.value, str)):
+                        h.payload = "dict"
+            elif isinstance(node, ast.Await):
+                if (isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func)
+                        == "self._journal_wait"):
+                    # End line, not start: the buffered record is often
+                    # nested inside the wait call itself —
+                    # ``await self._journal_wait(self._journal(...))`` —
+                    # and the buffer's lineno lands past the Await's.
+                    h.wait_lines.append(
+                        getattr(node, "end_lineno", None) or node.lineno)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in ("self._journal", "self._journal_actor"):
+                    h.journaling = True
+                    h.buffer_lines.append(node.lineno)
+                elif name.startswith("self.") and "." not in name[5:]:
+                    meth = name[5:]
+                    if meth in journal_direct:
+                        h.journaling = True
+                        h.buffer_lines.append(node.lineno)
+                        if meth in journal_waits:
+                            h.wait_lines.append(node.lineno)
+                if (name.endswith(".get") and isinstance(
+                        node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == data):
+                    h.payload = "dict"
+                # one resolver hop: data handed whole to a decoder
+                if (data is not None and h.arity_exact is None
+                        and not name.startswith("self._journal")):
+                    for i, a in enumerate(node.args):
+                        if isinstance(a, ast.Name) and a.id == data:
+                            dec = self._decoder_arity(h.path, h.cls,
+                                                      name, i)
+                            if dec is not None:
+                                h.arity_exact, h.payload = dec, "seq"
+                            break
+            elif (isinstance(node, ast.Return) and node.value is not None
+                  and not (isinstance(node.value, ast.Constant)
+                           and node.value.value is None)):
+                h.value_return_lines.append(node.lineno)
+        if h.arity_exact is None and max_idx >= 0:
+            h.arity_min = max(h.arity_min, max_idx + 1)
+
+    @staticmethod
+    def _note_len_guard(node: ast.Compare, data: Optional[str],
+                        guarded: Set[int]):
+        """``len(data) > 2`` (or ``>= 3``) marks data[2:] as optional
+        for the arity floor."""
+        if not (data is not None
+                and isinstance(node.left, ast.Call)
+                and dotted_name(node.left.func) == "len"
+                and node.left.args
+                and isinstance(node.left.args[0], ast.Name)
+                and node.left.args[0].id == data
+                and len(node.ops) == 1):
+            return
+        cmp = node.comparators[0]
+        if not (isinstance(cmp, ast.Constant)
+                and isinstance(cmp.value, int)):
+            return
+        if isinstance(node.ops[0], ast.Gt):
+            start = cmp.value
+        elif isinstance(node.ops[0], ast.GtE):
+            start = cmp.value - 1
+        else:
+            return
+        guarded.update(range(max(0, start), max(0, start) + 16))
+
+    def _decoder_arity(self, path, cls_name, callee: str,
+                       pos: int) -> Optional[int]:
+        """Exact wire arity of a decoder the data param is handed to,
+        one hop only (``_spec_from_slim(wire)`` -> its N-tuple unpack)."""
+        if self._index is None:
+            return None
+        m = self._index.modules.get(path)
+        if m is None:
+            return None
+        parts = callee.split(".")
+        q = None
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            q = m.classes.get(cls_name, {}).get(parts[1])
+        elif len(parts) == 1:
+            q = m.top.get(callee)
+        elif len(parts) == 2:
+            q = m.classes.get(parts[0], {}).get(parts[1])
+        fi = self._index.functions.get(q) if q else None
+        if fi is None:
+            return None
+        args = fi.node.args.args
+        skip = 1 if args and args[0].arg in ("self", "cls") else 0
+        if pos + skip >= len(args):
+            return None
+        pname = args[pos + skip].arg
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == pname
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Tuple)
+                    and not any(isinstance(e, ast.Starred)
+                                for e in node.targets[0].elts)):
+                return len(node.targets[0].elts)
+        return None
+
+    # ------------------------------------------------------- call sites
+
+    def _scan_call(self, path, node: ast.Call, cls_name, fn_stack,
+                   embedded, is_config, m):
+        name = dotted_name(node.func)
+        func_line = fn_stack[-1].lineno if fn_stack else None
+
+        # knob definition: _d("x", ...) / GLOBAL_CONFIG.define("x", ...)
+        if is_config and name in ("_d", "GLOBAL_CONFIG.define") and (
+                node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            self.knob_defs.setdefault(
+                node.args[0].value, (path, node.lineno))
+            return
+
+        # strong read: GLOBAL_CONFIG.get("x") — the base must resolve to
+        # the real config singleton, not any dict that happens to be
+        # named ``config`` (deployment specs in serve/ are plain dicts).
+        is_cfg_get = False
+        if name.endswith(".get"):
+            cbase = name[: -len(".get")]
+            chead, _, crest = cbase.partition(".")
+            if m is not None:
+                chead = m.symbols.get(chead, m.aliases.get(chead, chead))
+            is_cfg_get = (chead + ("." + crest if crest else "")
+                          ).endswith("GLOBAL_CONFIG")
+        if (is_cfg_get and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            self.strong_reads.setdefault(node.args[0].value, []).append(
+                (path, node.lineno, node.col_offset))
+
+        # handler_table(self): the enclosing class is dedup-reachable
+        if name.endswith("handler_table") and cls_name and any(
+                isinstance(a, ast.Name) and a.id == "self"
+                for a in node.args):
+            self._table_classes.add(f"{path}::{cls_name}")
+
+        # typed send APIs
+        fq = None
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            mpos = _SEND_APIS.get(attr)
+            if mpos is not None and len(node.args) > mpos:
+                methods = _const_strings(node.args[mpos])
+                if methods:
+                    payload = (node.args[mpos + 1]
+                               if len(node.args) > mpos + 1 else None)
+                    nargs = (len(payload.elts) if isinstance(
+                        payload, (ast.List, ast.Tuple)) else None)
+                    dedup = None
+                    for kw in node.keywords:
+                        if kw.arg == "dedup" and isinstance(
+                                kw.value, ast.Constant):
+                            dedup = bool(kw.value.value)
+                    self.send_sites.append(SendSite(
+                        path, node.lineno, node.col_offset, func_line,
+                        attr, dotted_name(node.func.value), methods,
+                        nargs, dedup, embedded))
+                elif (isinstance(node.args[mpos], ast.Name)
+                      and fn_stack and not embedded
+                      and self._index is not None):
+                    # forwarder shape: own param in the method slot
+                    fq = self._enclosing_qname(path, cls_name, fn_stack)
+                    fi = self._index.functions.get(fq) if fq else None
+                    if fi is not None:
+                        params = [a.arg for a in fi.node.args.args]
+                        pid = node.args[mpos].id
+                        if pid in params:
+                            skip = 1 if params and params[0] in (
+                                "self", "cls") else 0
+                            self._send_forwarders.setdefault(fq, (
+                                params.index(pid) - skip,
+                                dotted_name(node.func.value), attr))
+
+        # config forwarder: own param lands in a CONFIG .get
+        if (is_cfg_get and node.args
+                and isinstance(node.args[0], ast.Name)
+                and fn_stack and not embedded
+                and self._index is not None):
+            fq = fq or self._enclosing_qname(path, cls_name, fn_stack)
+            fi = self._index.functions.get(fq) if fq else None
+            if fi is not None:
+                params = [a.arg for a in fi.node.args.args]
+                if node.args[0].id in params:
+                    skip = 1 if params and params[0] in ("self",
+                                                         "cls") else 0
+                    self._cfg_forwarders.add(
+                        (fq, params.index(node.args[0].id) - skip))
+
+        # weak caller/knob references + pending forwarder-lift calls
+        arg_strings: Dict[int, List[str]] = {}
+        arg_seq_lens: Dict[int, int] = {}
+        for i, a in enumerate(node.args):
+            ss = _const_strings(a)
+            if ss:
+                arg_strings[i] = ss
+            if isinstance(a, (ast.List, ast.Tuple)):
+                arg_seq_lens[i] = len(a.elts)
+        if not is_config:
+            subtrees = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+            for a in subtrees:
+                for sub in ast.walk(a):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)
+                            and len(sub.value) < 64
+                            and _IDENT_RE.match(sub.value)):
+                        self.weak_strings.add(sub.value)
+        if (arg_strings and fn_stack and not embedded
+                and self._index is not None):
+            fq = fq or self._enclosing_qname(path, cls_name, fn_stack)
+            fi = self._index.functions.get(fq) if fq else None
+            if fi is not None and m is not None:
+                target = self._index._resolve_call(fi, m, name)
+                if target is not None:
+                    self._pending.append(_PendingCall(
+                        path, target, node.lineno, node.col_offset,
+                        func_line, arg_strings, arg_seq_lens, embedded))
+
+    def _scan_attr_read(self, path, node: ast.Attribute, m):
+        """Strong config read: GLOBAL_CONFIG.<knob> attribute access,
+        through import aliases (``from .config import GLOBAL_CONFIG``,
+        ``config.GLOBAL_CONFIG``)."""
+        base = dotted_name(node.value)
+        if not base or "?" in base:
+            return
+        head, _, rest = base.partition(".")
+        if m is not None:
+            head = m.symbols.get(head, m.aliases.get(head, head))
+        full = head + ("." + rest if rest else "")
+        if not full.endswith("GLOBAL_CONFIG"):
+            return
+        if node.attr.startswith("_") or node.attr in _CONFIG_API:
+            return
+        self.strong_reads.setdefault(node.attr, []).append(
+            (path, node.lineno, node.col_offset))
+
+    def _scan_transport(self, base: str, tree: ast.AST):
+        idents: Set[str] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Name):
+                idents.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr)
+        self.transports[base] = {
+            "run_idempotent": "run_idempotent" in idents,
+            "epoch_in_reply": "_EPOCH_PROVIDER" in idents,
+        }
+
+    # ---------------------------------------------------------- helpers
+
+    @staticmethod
+    def _enclosing_qname(path, cls_name, fn_stack) -> Optional[str]:
+        if not fn_stack:
+            return None
+        quals: List[str] = [cls_name] if cls_name else []
+        quals.extend(f.name for f in fn_stack)
+        return f"{path}::{'.'.join(quals)}"
+
+    @staticmethod
+    def _plane_for(cls_name: Optional[str]) -> str:
+        if not cls_name:
+            return "?"
+        return _PLANE_NAMES.get(cls_name, cls_name.lower())
+
+    def _add_handler(self, h: Handler):
+        for prev in self.handlers.get(h.method, ()):
+            if (prev.plane, prev.kind) == (h.plane, h.kind):
+                return
+        self.handlers.setdefault(h.method, []).append(h)
+
+    # ------------------------------------------------------------ resolve
+
+    def _resolve(self):
+        for h, fn in self._deferred:
+            self._analyze_handler(h, fn)
+        for hs in self.handlers.values():
+            for h in hs:
+                if h.kind == "rpc":
+                    h.dedup_reachable = (
+                        f"{h.path}::{h.cls}" in self._table_classes)
+        # lift forwarder callers into send sites / strong reads
+        for pc in self._pending:
+            fwd = self._send_forwarders.get(pc.target)
+            if fwd is not None:
+                mpos, receiver, api = fwd
+                methods = pc.arg_strings.get(mpos)
+                if methods:
+                    self.send_sites.append(SendSite(
+                        pc.path, pc.lineno, pc.col, pc.func_line,
+                        api, receiver, methods,
+                        pc.arg_seq_lens.get(mpos + 1), None,
+                        pc.embedded))
+            for fq, cpos in self._cfg_forwarders:
+                if pc.target == fq and cpos in pc.arg_strings:
+                    for s in pc.arg_strings[cpos]:
+                        self.strong_reads.setdefault(s, []).append(
+                            (pc.path, pc.lineno, pc.col))
+
+    # ------------------------------------------------------------- check
+
+    def _check(self):
+        site_methods: Set[str] = set()
+        for s in self.send_sites:
+            site_methods.update(s.methods)
+        called = site_methods | self.weak_strings
+        plane_keys = sorted(_REAL_PLANES & set(self.planes))
+
+        def add(path, line, col, rule, msg, func_line=None):
+            self._findings_by_file.setdefault(path, []).append(
+                Finding(path, line, col, rule, msg, func_line=func_line))
+
+        # ---- R10: call sites resolve; plane coherent; arity compatible
+        for s in self.send_sites:
+            if s.embedded or _is_test_path(s.path):
+                continue
+            for mname in s.methods:
+                cands = self.handlers.get(mname)
+                if not cands:
+                    add(s.path, s.lineno, s.col, "R10",
+                        f'unknown wire method "{mname}" sent via '
+                        f".{s.api}() on `{s.receiver}`: no rpc_{mname} "
+                        f"handler, notify registration, or fast-dispatch "
+                        f"string anywhere in the tree (typo, or a "
+                        f"handler was removed without its callers)",
+                        func_line=s.func_line)
+                    continue
+                rtoks = set(_TOKEN_RE.split(s.receiver.lower()))
+                hits = [p for p in plane_keys if p in rtoks]
+                hint = hits[0] if len(hits) == 1 else None
+                if hint is not None and not any(
+                        h.plane == hint for h in cands):
+                    has = ", ".join(sorted({h.plane for h in cands}))
+                    add(s.path, s.lineno, s.col, "R10",
+                        f'wire method "{mname}" sent to a `{s.receiver}` '
+                        f"connection but no handler exists on the "
+                        f"{hint} plane (found on: {has}) — wrong plane, "
+                        f"or the handler moved",
+                        func_line=s.func_line)
+                    continue
+                if s.nargs is not None:
+                    pool = [h for h in cands
+                            if hint is None or h.plane == hint]
+                    ok = not pool or any(
+                        (h.arity_exact is None
+                         and s.nargs >= h.arity_min)
+                        or h.arity_exact == s.nargs
+                        for h in pool)
+                    if not ok:
+                        want = ", ".join(sorted({
+                            (f"exactly {h.arity_exact}"
+                             if h.arity_exact is not None
+                             else f">= {h.arity_min}")
+                            for h in pool}))
+                        add(s.path, s.lineno, s.col, "R10",
+                            f'arity skew: "{mname}" sent with a '
+                            f"{s.nargs}-element payload but the handler "
+                            f"unpacks {want} (cross-transport wire "
+                            f"contract broken — fix the payload or the "
+                            f"handler)", func_line=s.func_line)
+        # ---- R10: dead handlers
+        for mname in sorted(self.handlers):
+            for h in self.handlers[mname]:
+                if h.kind != "rpc" or _is_test_path(h.path):
+                    continue
+                if mname not in called:
+                    add(h.path, h.lineno, 0, "R10",
+                        f"dead handler rpc_{mname} on {h.cls}: no send "
+                        f"site, embedded script, or string reference "
+                        f"anywhere names it — delete it or wire a "
+                        f"caller (dead wire surface hides contract "
+                        f"drift)", func_line=h.lineno)
+
+        # ---- R11: mutation durability on journaling handlers
+        dedupless: Dict[str, List[SendSite]] = {}
+        for s in self.send_sites:
+            if (s.dedup is False and not s.embedded
+                    and not _is_test_path(s.path)):
+                for mname in s.methods:
+                    dedupless.setdefault(mname, []).append(s)
+        for mname in sorted(self.handlers):
+            for h in self.handlers[mname]:
+                if (h.kind != "rpc" or not h.journaling
+                        or _is_test_path(h.path)):
+                    continue
+                if not h.dedup_reachable:
+                    add(h.path, h.lineno, 0, "R11",
+                        f"journaling handler rpc_{mname} on {h.cls} is "
+                        f"not dedup-reachable: its class is never "
+                        f"served via rpc.handler_table, so a replayed "
+                        f"request double-applies the mutation",
+                        func_line=h.lineno)
+                if not h.wait_lines:
+                    add(h.path, h.lineno, 0, "R11",
+                        f"acked-before-durable: rpc_{mname} buffers a "
+                        f"journal record but never awaits "
+                        f"self._journal_wait — the reply can reach the "
+                        f"client before the record is durable (the "
+                        f"r7/r16 durable-at-ack invariant)",
+                        func_line=h.lineno)
+                else:
+                    for r in h.value_return_lines:
+                        bufs = [b for b in h.buffer_lines if b <= r]
+                        if not bufs:
+                            continue
+                        b = max(bufs)
+                        if not any(b <= w <= r for w in h.wait_lines):
+                            add(h.path, r, 0, "R11",
+                                f"acked-before-durable: rpc_{mname} "
+                                f"replies at line {r} after buffering "
+                                f"a journal record (line {b}) with no "
+                                f"awaited self._journal_wait between "
+                                f"them", func_line=h.lineno)
+                for s in dedupless.get(mname, ()):
+                    if not h.doc_idempotent:
+                        add(s.path, s.lineno, s.col, "R11",
+                            f'replayable-non-idempotent: "{mname}" is '
+                            f"called with dedup=False but its handler "
+                            f"journals a mutation and does not declare "
+                            f"application-level idempotence in its "
+                            f"docstring", func_line=s.func_line)
+
+        # ---- R12: knob drift
+        if self.knob_defs:
+            design = self._design_text()
+            for kname in sorted(self.knob_defs):
+                kpath, kline = self.knob_defs[kname]
+                if (kname not in self.strong_reads
+                        and kname not in self.weak_strings):
+                    add(kpath, kline, 0, "R12",
+                        f'dead knob "{kname}": defined in config.py '
+                        f"but never read via GLOBAL_CONFIG anywhere — "
+                        f"prune it or wire the subsystem that was "
+                        f"meant to honor it")
+                elif design is not None and not re.search(
+                        r"\b%s\b" % re.escape(kname), design):
+                    add(kpath, kline, 0, "R12",
+                        f'undocumented knob "{kname}": missing from '
+                        f"DESIGN.md — document what it tunes and its "
+                        f"default")
+            for rname in sorted(self.strong_reads):
+                if rname in self.knob_defs:
+                    continue
+                for (rpath, rline, rcol) in self.strong_reads[rname]:
+                    if _is_test_path(rpath):
+                        continue
+                    add(rpath, rline, rcol, "R12",
+                        f'phantom config read "{rname}": read via '
+                        f"GLOBAL_CONFIG but never defined in config.py "
+                        f"(AttributeError at runtime)")
+
+        # ---- R10: lock drift (only when this module itself is in the
+        # linted set — a fixture-dir run must not diff against the
+        # repo's lock)
+        if self.root is not None and any(
+                p.endswith(_SELF_RELPATH) for p in self._paths):
+            lock_path = os.path.join(self.root, *_LOCK_RELPATH.split("/"))
+            if not os.path.isfile(lock_path):
+                self.lock_drift = (
+                    f"wire-surface lock missing: {_LOCK_RELPATH} is not "
+                    f"checked in — generate it with `python -m "
+                    f"tools.raylint --contracts {_LOCK_RELPATH} "
+                    f"ray_tpu tests tools`")
+            else:
+                try:
+                    with open(lock_path, "r", encoding="utf-8") as f:
+                        on_disk = json.load(f)
+                except (OSError, ValueError):
+                    on_disk = None
+                if on_disk != self.as_lock():
+                    self.lock_drift = (
+                        f"wire-surface drift: {_LOCK_RELPATH} does not "
+                        f"match the extracted contract registry — "
+                        f"review the wire change, then regenerate with "
+                        f"`python -m tools.raylint --contracts "
+                        f"{_LOCK_RELPATH} ray_tpu tests tools`")
+
+    def _design_text(self) -> Optional[str]:
+        if self.root is None:
+            return None
+        try:
+            with open(os.path.join(self.root, "DESIGN.md"), "r",
+                      encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # ------------------------------------------------------------- query
+
+    def findings_for(self, path: str, enabled: Set[str]) -> List[Finding]:
+        return [f for f in self._findings_by_file.get(path, ())
+                if f.rule in enabled]
+
+    def as_lock(self) -> dict:
+        """The stable-sorted, lineno-free registry: the lock artifact.
+        Only the real tree is locked — fixture servers under tests/
+        would churn the artifact without changing the wire."""
+        planes: Dict[str, dict] = {}
+        for mname in sorted(self.handlers):
+            for h in sorted(self.handlers[mname],
+                            key=lambda h: (h.plane, h.kind)):
+                if _is_test_path(h.path):
+                    continue
+                p = planes.setdefault(h.plane, {
+                    "class": h.cls,
+                    "file": h.path.replace(os.sep, "/"),
+                    "handlers": {},
+                })
+                p["handlers"].setdefault(mname, h.as_lock())
+        sites: List[dict] = []
+        seen = set()
+        for s in self.send_sites:
+            if _is_test_path(s.path):
+                continue
+            d = s.as_lock()
+            k = json.dumps(d, sort_keys=True)
+            if k not in seen:
+                seen.add(k)
+                sites.append(d)
+        sites.sort(key=lambda d: (d["file"], d["methods"], d["api"],
+                                  str(d["nargs"])))
+        return {
+            "version": 1,
+            "planes": {k: planes[k] for k in sorted(planes)},
+            "send_sites": sites,
+            "transports": {k: self.transports[k]
+                           for k in sorted(self.transports)},
+            "knobs": {
+                k: {"read": (k in self.strong_reads
+                             or k in self.weak_strings)}
+                for k in sorted(self.knob_defs)
+            },
+        }
+
+
+def attach(index: ProjectIndex, files: List[Tuple[str, ast.AST]],
+           root: Optional[str]) -> ContractRegistry:
+    """Build the registry once per lint run and hang it on the pass-1
+    index, where the rule driver picks it up per file."""
+    reg = ContractRegistry.build(files, index, root)
+    index.contracts = reg
+    return reg

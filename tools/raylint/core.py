@@ -4,11 +4,16 @@ reporting (text / JSON / SARIF) and the ``--changed`` mode.
 The rule checkers live in :mod:`tools.raylint.rules`; the pass-1
 project index (symbol table + call graph) lives in
 :mod:`tools.raylint.graph`.  This module owns everything
-rule-independent — parsing, the two-pass orchestration (**pass 1**
-parses every file and builds one ``ProjectIndex`` over the whole
-input set, **pass 2** runs the rules per file with the index in hand,
-so the flow rules R7/R8 see cross-module call chains), the
-``# raylint: disable=<rule>`` suppression protocol, and the reports.
+rule-independent — parsing, the pass orchestration (**pass 1** parses
+every file and builds one ``ProjectIndex`` over the whole input set;
+**pass 3's prologue** extracts the wire-contract registry from the
+same trees and hangs it on the index (:mod:`tools.raylint.contracts`,
+r17); **pass 2** runs the rules per file with the index in hand, so
+the flow rules R7/R8 see cross-module call chains and the contract
+rules R10–R12 see the whole wire surface), the ``# raylint:
+disable=<rule>`` suppression protocol, and the reports.  ``--contracts
+<out.json>`` additionally emits the extracted registry stable-sorted —
+the lock artifact checked in as ``tools/raylint/contracts.lock.json``.
 
 Suppression protocol: a finding is silenced when a ``# raylint:
 disable=R3 — reason`` (rule id, rule name, or ``all``; comma-separated
@@ -46,8 +51,17 @@ RULES = {
     "R7": "transitive-blocking",
     "R8": "lock-across-await",
     "R9": "typed-error-chain",
+    "R10": "method-contract",
+    "R11": "mutation-durability",
+    "R12": "knob-drift",
     "S1": "unused-suppression",
 }
+#: the r17 contract rules need the cross-file wire registry built
+#: before pass 2 runs (see tools/raylint/contracts.py)
+_CONTRACT_RULES = frozenset({"R10", "R11", "R12"})
+#: registry from the most recent lint_paths run (the ``--contracts``
+#: emitter reads it back instead of re-extracting)
+_LAST_CONTRACTS = None
 _NAME_TO_ID = {name: rid for rid, name in RULES.items()}
 
 _DISABLE_RE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\- ]+)")
@@ -212,9 +226,13 @@ def lint_source(source: str, path: str,
     single-file project index is built, so the flow rules R7/R8 still
     see call chains *within* the file."""
     tree = ast.parse(source, filename=path)
+    enabled = set(rules) if rules else set(RULES)
     if index is None:
         index = ProjectIndex.build([(path, tree)])
-    enabled = set(rules) if rules else set(RULES)
+        if _CONTRACT_RULES & enabled:
+            from tools.raylint import contracts as _contracts
+
+            _contracts.attach(index, [(path, tree)], root=None)
     return _lint_tree(tree, source, path, enabled, index)
 
 
@@ -297,6 +315,18 @@ def lint_paths(paths: Iterable[str], root: str = ".",
         parsed.append((rel, source, tree))
     index = ProjectIndex.build([(rel, tree) for rel, _, tree in parsed])
 
+    # ---- pass 3 prologue (r17): extract the wire-contract registry
+    # over the whole input set and hang it on the index; pass 2's rule
+    # driver dispatches its R10–R12 findings per file
+    registry = None
+    if _CONTRACT_RULES & enabled:
+        from tools.raylint import contracts as _contracts
+
+        registry = _contracts.attach(
+            index, [(rel, tree) for rel, _, tree in parsed], root=root)
+        global _LAST_CONTRACTS
+        _LAST_CONTRACTS = registry
+
     # ---- pass 2: flow-aware rules per file, suppression accounting
     findings: List[Finding] = []
     suppressed = 0
@@ -304,6 +334,12 @@ def lint_paths(paths: Iterable[str], root: str = ".",
         vis, supp = _lint_tree(tree, source, rel, enabled, index)
         findings.extend(vis)
         suppressed += supp
+    # lock drift attaches to the JSON artifact, not a .py file, so it
+    # bypasses the per-file suppression protocol by construction
+    if registry is not None and registry.lock_drift and "R10" in enabled:
+        findings.append(Finding(
+            "tools/raylint/contracts.lock.json", 1, 0, "R10",
+            registry.lock_drift))
 
     changed_detail = None
     if changed_ref is not None:
@@ -390,7 +426,7 @@ def format_sarif(report: dict) -> str:
             "tool": {
                 "driver": {
                     "name": "raylint",
-                    "version": "2.0",
+                    "version": "3.0",
                     "informationUri": (
                         "DESIGN.md#enforced-invariants-raylint"
                     ),
@@ -415,6 +451,7 @@ def main(argv: List[str]) -> int:
     as_sarif = False
     rules: Optional[List[str]] = None
     changed_ref: Optional[str] = None
+    contracts_out: Optional[str] = None
     paths: List[str] = []
     it = iter(argv)
     for a in it:
@@ -422,6 +459,19 @@ def main(argv: List[str]) -> int:
             as_json = True
         elif a == "--sarif":
             as_sarif = True
+        elif a.startswith("--contracts"):
+            if a.startswith("--contracts="):
+                contracts_out = a.split("=", 1)[1]
+            else:
+                try:
+                    contracts_out = next(it)
+                except StopIteration:
+                    contracts_out = None
+            if not contracts_out:
+                print("raylint: --contracts needs an output path "
+                      "(e.g. --contracts tools/raylint/"
+                      "contracts.lock.json)", flush=True)
+                return 2
         elif a.startswith("--changed"):
             if a.startswith("--changed="):
                 changed_ref = a.split("=", 1)[1]
@@ -456,7 +506,8 @@ def main(argv: List[str]) -> int:
             paths.append(a)
     if not paths:
         print("usage: python -m tools.raylint [--json|--sarif] "
-              "[--rules R1,R7] [--changed <git-ref>] <path> [<path> ...]",
+              "[--rules R1,R7] [--changed <git-ref>] "
+              "[--contracts <out.json>] <path> [<path> ...]",
               flush=True)
         return 2
     try:
@@ -464,6 +515,16 @@ def main(argv: List[str]) -> int:
     except RuntimeError as e:
         print(f"raylint: {e}", flush=True)
         return 2
+    if contracts_out:
+        if _LAST_CONTRACTS is None:
+            print("raylint: --contracts needs the contract rules "
+                  "enabled (R10/R11/R12 were excluded by --rules)",
+                  flush=True)
+            return 2
+        with open(contracts_out, "w", encoding="utf-8") as f:
+            json.dump(_LAST_CONTRACTS.as_lock(), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
     if as_sarif:
         print(format_sarif(report))
     elif as_json:

@@ -106,6 +106,19 @@ def walk_body(fn: ast.AST):
         stack.extend(ast.iter_child_nodes(node))
 
 
+def _iter_stmts(tree: ast.AST):
+    """Yield statement-level nodes only, skipping expression subtrees
+    (where ``Import``/``ImportFrom`` can never appear) — the bulk of a
+    module's nodes."""
+    stack: List[ast.AST] = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.expr):
+                yield child
+                stack.append(child)
+
+
 class CallSite:
     """One call expression inside a function body."""
 
@@ -207,7 +220,10 @@ class ProjectIndex:
         self._by_path[path] = []
         self._modname_to_path[modname] = path
 
-        for node in ast.walk(tree):
+        # imports are statements (never inside an expression subtree),
+        # so the pre-pass skips expression subtrees entirely — the bulk
+        # of the tree — instead of a full ast.walk
+        for node in _iter_stmts(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     m.aliases[a.asname or a.name.split(".")[0]] = a.name

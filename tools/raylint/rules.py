@@ -1,4 +1,4 @@
-"""raylint rule checkers R1–R9.
+"""raylint rule checkers R1–R12.
 
 Every rule is grounded in an invariant this codebase already relies on
 (see DESIGN.md "Enforced invariants" for the PR that introduced each):
@@ -52,6 +52,24 @@ R9 typed-error-chain       (PR 14) a mid-soak failure must surface as
                            ``asyncio.TimeoutError`` raise escapes the
                            repo's typed-exception surface
                            (``ray_tpu/exceptions.py``).
+R10 method-contract        (r17, contract pass) every ``.call("m",
+                           ...)`` / notify method string must resolve
+                           to a handler on the hinted plane with
+                           compatible wire arity, and every ``rpc_``
+                           handler must have a caller — the stringly-
+                           typed dispatch contract, verified the way
+                           the reference encodes its service surface
+                           in checked proto definitions.
+R11 mutation-durability    (r17, contract pass) a journaling GCS
+                           handler must be dedup-reachable (served via
+                           ``rpc.handler_table`` → ``run_idempotent``)
+                           and must await ``self._journal_wait``
+                           between buffering and replying — the r7/r16
+                           durable-at-ack invariant, statically.
+R12 knob-drift             (r17, contract pass) every ``_d()``-defined
+                           knob in config.py is read somewhere via
+                           ``GLOBAL_CONFIG``, every read is defined,
+                           and every knob is documented in DESIGN.md.
 
 Scoping: R1 applies to files under a ``_private/`` directory; R3 and the
 module prong of R4 apply to the wire/control modules by basename (R4
@@ -68,6 +86,14 @@ scoping); R9 applies to the control-plane packages — files under
 ``_private/``, ``serve/`` or ``mesh/``, plus the provisioning client
 files ``autoscaler.py`` / ``cloud_rest.py`` (PR 15: heal-loop error
 chains must attribute, a blank timeout is an unattributable MTTR).
+The r17 contract rules R10–R12 are computed once per run over the
+whole input set (:mod:`tools.raylint.contracts` hangs the registry on
+the pass-1 index) and dispatched here per file; like ``--changed``,
+they assume the documented root set ``ray_tpu tests tools`` — a
+partial run sees a partial wire surface and may over-report dead
+handlers/knobs.  Their findings skip files under ``tests/`` /
+``examples/`` (fixture servers use throwaway method strings by
+design), though handlers and callers are collected from everywhere.
 """
 
 from __future__ import annotations
@@ -241,13 +267,11 @@ def _check_r1(fn, path: str, aliases,
                     func_line=fn.lineno))
 
 
-def _check_r2(tree: ast.AST, path: str, func_of,
+def _check_r2(all_calls: List[ast.Call], path: str, func_of,
               findings: List[Finding]):
     wrapped: Set[int] = set()
     handler_calls: List[ast.Call] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in all_calls:
         if _dotted(node.func).endswith("run_idempotent"):
             wrapped |= _subtree_calls(node)
         if (isinstance(node.func, ast.Attribute)
@@ -342,12 +366,10 @@ def _check_r4(fn_nodes, path: str, aliases,
                     f"parameter instead", func_line=fn.lineno))
 
 
-def _check_r5(tree: ast.AST, path: str, func_of,
+def _check_r5(all_calls: List[ast.Call], path: str, func_of,
               findings: List[Finding]):
     base = os.path.basename(path)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in all_calls:
         writable = any(
             kw.arg == "writable"
             and isinstance(kw.value, ast.Constant)
@@ -559,17 +581,23 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
     _INDEXED = (ast.Call, ast.Raise, ast.ExceptHandler, ast.With,
                 ast.AsyncWith, ast.FunctionDef, ast.AsyncFunctionDef)
 
-    def index_parents(node, fn):
-        for child in ast.iter_child_nodes(node):
+    # the same walk also collects every Call node, so whole-tree call
+    # rules (R2, R5) iterate a list instead of re-walking the tree
+    all_calls: List[ast.Call] = []
+
+    _ip_stack: List = [(tree, None)]
+    while _ip_stack:
+        _ip_node, _ip_fn = _ip_stack.pop()
+        for child in ast.iter_child_nodes(_ip_node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                parent_fn[id(child)] = fn
-                index_parents(child, child)
+                parent_fn[id(child)] = _ip_fn
+                _ip_stack.append((child, child))
             else:
                 if isinstance(child, _INDEXED):
-                    parent_fn[id(child)] = fn
-                index_parents(child, fn)
-
-    index_parents(tree, None)
+                    parent_fn[id(child)] = _ip_fn
+                    if isinstance(child, ast.Call):
+                        all_calls.append(child)
+                _ip_stack.append((child, _ip_fn))
 
     def func_of(node) -> Optional[ast.AST]:
         return parent_fn.get(id(node))
@@ -586,13 +614,13 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
                                       ast.AsyncFunctionDef))]
 
     if "R2" in enabled:
-        _check_r2(tree, path, func_of, findings)
+        _check_r2(all_calls, path, func_of, findings)
     if "R3" in enabled and base in _R3_FILES:
         _check_r3(fn_nodes, path, func_of, findings)
     if "R4" in enabled:
         _check_r4(fn_nodes, path, aliases, findings)
     if "R5" in enabled:
-        _check_r5(tree, path, func_of, findings)
+        _check_r5(all_calls, path, func_of, findings)
     # R9 scope (PR 15 widened): control-plane packages (_private/,
     # serve/) plus the elastic compute plane — mesh/ and the
     # provisioning client files, whose error chains feed heal-loop
@@ -630,5 +658,11 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
                 doc = (ast.get_docstring(node) or "").lower()
                 if any(m in doc for m in _R1_LOOP_MARKERS):
                     _check_r1(node, path, aliases, findings)
+    # r17 contract rules: computed once per run over the whole input
+    # set, attached to the index by core.lint_paths/lint_source,
+    # dispatched here per file so suppressions apply normally
+    registry = getattr(index, "contracts", None)
+    if registry is not None and {"R10", "R11", "R12"} & enabled:
+        findings.extend(registry.findings_for(path, enabled))
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
