@@ -790,6 +790,12 @@ def main():
         _contract = {
             r: _lint["counts"].get(r, 0) for r in ("R10", "R11", "R12")
         }
+        # lifecycle rules (raylint 4.0 fourth pass, CFG-driven) broken
+        # out likewise: a leaked acquire path, cancellation-unsafe
+        # window, or orphaned task shows up as its own counter
+        _lifecycle = {
+            r: _lint["counts"].get(r, 0) for r in ("R13", "R14", "R15")
+        }
         raylint_detail = {
             "findings": len(_lint["findings"]),
             "parse_errors": len(_lint["errors"]),
@@ -797,7 +803,8 @@ def main():
             "unused_suppressions": _lint["unused_suppressions"],
             "by_rule": _lint["counts"],
             "contract_findings": sum(_contract.values()),
-            # acceptance bound: full-tree analysis (all three passes)
+            "lifecycle_findings": sum(_lifecycle.values()),
+            # acceptance bound: full-tree analysis (all four passes)
             # must stay under 5s on an idle machine — recorded, not
             # hard-gated, because bench runs share the box with the
             # perf workload and wall time is load-sensitive

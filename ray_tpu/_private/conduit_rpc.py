@@ -584,8 +584,8 @@ class ConduitConnection:
 
     def _spawn_handler(self, kind, seqno, method, data, rid=None,
                        epoch=None):
-        self.loop.create_task(
-            self._handle(kind, seqno, method, data, rid, epoch))
+        # runs via call_soon_threadsafe, so always on the loop
+        rpc.spawn(self._handle(kind, seqno, method, data, rid, epoch))
 
     async def _handle(self, kind, seqno, method, data, rid=None,
                       epoch=None):

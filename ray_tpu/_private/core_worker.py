@@ -126,13 +126,20 @@ class _ActorWindow:
     loop futures woken via call_soon_threadsafe — the throughput path
     pays the hop only when the window is actually contended."""
 
-    __slots__ = ("_credits", "_lock", "_waiters", "_loop")
+    __slots__ = ("_credits", "_lock", "_waiters", "_loop", "_cap")
 
     def __init__(self, credits: int, loop):
         self._credits = credits
+        self._cap = credits
         self._lock = threading.Lock()
         self._waiters: collections.deque = collections.deque()
         self._loop = loop
+
+    def outstanding(self) -> int:
+        """Claimed-but-unreleased credits (leak ledger input: zero when
+        no calls are in flight)."""
+        with self._lock:
+            return self._cap - self._credits
 
     def try_acquire(self) -> bool:
         """Non-blocking claim; any thread."""
@@ -822,6 +829,9 @@ class CoreWorker:
         buf = self._create_with_spill(oid, total)
         try:
             serialization.pack_into(meta, views, buf)
+        except BaseException:
+            self.store.abort(oid)
+            raise
         finally:
             del buf
         self.store.seal(oid)
@@ -858,6 +868,9 @@ class CoreWorker:
                     await asyncio.sleep(0.05)
         try:
             serialization.pack_into(meta, views, buf)
+        except BaseException:
+            self.store.abort(oid)
+            raise
         finally:
             del buf
         self.store.seal(oid)
@@ -1599,7 +1612,7 @@ class CoreWorker:
         have = st.requests_in_flight + woken
         for _ in range(min(want - have, 8)):
             st.requests_in_flight += 1
-            asyncio.get_running_loop().create_task(self._lease_loop(key, st))
+            rpc.spawn(self._lease_loop(key, st))
 
     async def _lease_loop(self, key: Tuple, st: _LeaseState):
         granted = False
@@ -2214,15 +2227,12 @@ class CoreWorker:
                 self._actor_conc_cache.setdefault(aid, 1)
         if self._actor_conc_cache.get(aid, 1) > 1:
             try:
-                loop = asyncio.get_running_loop()
                 while q:
-                    loop.create_task(self._submit_actor_async(q.popleft()))
+                    rpc.spawn(self._submit_actor_async(q.popleft()))
             finally:
                 self._actor_pumping.discard(aid)
                 if q:
-                    asyncio.get_running_loop().create_task(
-                        self._actor_pump(aid)
-                    )
+                    rpc.spawn(self._actor_pump(aid))
             return
         corked = None  # conn holding corked pushes awaiting flush
         ncork = 0
@@ -2234,9 +2244,9 @@ class CoreWorker:
                 corked, ncork = None, 0
 
         try:
-            sem = self._actor_windows.get(aid)
-            if sem is None:
-                sem = self._actor_windows[aid] = _ActorWindow(
+            win = self._actor_windows.get(aid)
+            if win is None:
+                win = self._actor_windows[aid] = _ActorWindow(
                     max(1, GLOBAL_CONFIG.actor_pipeline_depth),
                     asyncio.get_running_loop(),
                 )
@@ -2257,12 +2267,12 @@ class CoreWorker:
                 except Exception as e:
                     self._fail_task(s, e)
                     continue
-                if not sem.available():
+                if not win.available():
                     # about to wait on the peer for a window slot: the
                     # corked pushes must hit the wire first (the replies
                     # that release slots depend on them)
                     uncork()
-                await sem.acquire()
+                await win.acquire()
                 # Streaming push (one CORKED notify frame per call — a
                 # burst goes out in one transport write): the slot is
                 # released on task_done / conn close.
@@ -2283,7 +2293,7 @@ class CoreWorker:
                 except Exception as e:  # e.g. GCS conn died at shutdown
                     self._fail_task(s, e)
                 finally:
-                    sem.release()
+                    win.release()
         finally:
             # in the finally: a cancelled/failing pump must still put its
             # corked pushes on the wire — their callers' refs hang forever
@@ -2294,9 +2304,7 @@ class CoreWorker:
                 # a submit-thread append raced the exit (it saw the pump
                 # still registered and skipped the kick): re-kick so the
                 # straggler doesn't strand until the next call
-                asyncio.get_running_loop().create_task(
-                    self._actor_pump(aid)
-                )
+                rpc.spawn(self._actor_pump(aid))
 
     async def _actor_address(self, actor_id: bytes, wait_alive=True):
         """Resolve an actor's address. While the actor is PENDING/RESTARTING
@@ -2625,9 +2633,7 @@ class CoreWorker:
             if spec.max_retries != 0:
                 if spec.max_retries > 0:
                     spec.max_retries -= 1
-                self.io.loop.create_task(
-                    self._submit_actor_async(spec, deps_resolved=True)
-                )
+                rpc.spawn(self._submit_actor_async(spec, deps_resolved=True))
             else:
                 self._fail_task(
                     spec,
@@ -3408,6 +3414,9 @@ class CoreWorker:
                         "kind": "p", "node": self.node_id}
             try:
                 serialization.pack_into(meta, views, buf)
+            except BaseException:
+                self.store.abort(oid)
+                raise
             finally:
                 del buf
             self.store.seal(oid)
@@ -3520,6 +3529,9 @@ class CoreWorker:
                 buf = self._create_with_spill(oid, total)
                 try:
                     serialization.pack_into(meta, views, buf)
+                except BaseException:
+                    self.store.abort(oid)
+                    raise
                 finally:
                     del buf
                 self.store.seal(oid)
@@ -3561,6 +3573,17 @@ class CoreWorker:
     async def rpc_ping(self, conn, _):
         return "pong"
 
+    def leak_stats(self) -> Dict[str, int]:
+        """Per-process resource-lifecycle ledger (r20): counters that
+        must be zero when no calls are in flight. Fed into the raylet's
+        node_stats["leaks"] via the task-stats fan-out."""
+        return {
+            "unsealed_creates": self.store.unsealed_creates,
+            "actor_window_outstanding": sum(
+                w.outstanding() for w in self._actor_windows.values()
+            ),
+        }
+
     async def rpc_task_stats(self, conn, _):
         """Task-plane counters (the raylet aggregates these per node
         into node_stats["task_plane"]; the perf bench reads the driver's
@@ -3568,6 +3591,7 @@ class CoreWorker:
         return {
             "task_inline_hits": self.task_inline_hits,
             "task_inline_bytes": self.task_inline_bytes,
+            "leaks": self.leak_stats(),
         }
 
     def as_future(self, ref: ObjectRef):

@@ -563,7 +563,7 @@ class GcsServer:
             pg.state in (PG_PENDING, PG_RESCHEDULING)
             for pg in self.placement_groups.values()
         ):
-            loop.create_task(self._recover_after_grace())
+            rpc.spawn(self._recover_after_grace())
         self._started.set()
 
     async def stop(self):
@@ -888,9 +888,8 @@ class GcsServer:
             return
         batch = {"epoch": self.epoch, "seq": t.records - len(frames),
                  "recs": frames}
-        loop = asyncio.get_running_loop()
         for conn in list(self._standby_conns):
-            loop.create_task(self._ship_send(conn, batch))
+            rpc.spawn(self._ship_send(conn, batch))
 
     async def _ship_send(self, conn: rpc.Connection, batch: Dict):
         try:
@@ -1028,7 +1027,7 @@ class GcsServer:
             "(promoted while this instance was dead or partitioned); "
             "ceasing to serve", peer_epoch, self.epoch)
         self._fenced.set()
-        asyncio.get_running_loop().create_task(self.stop())
+        rpc.spawn(self.stop())
 
     async def _recover_after_grace(self):
         """Journal-restored runtime state reconciliation: give raylets one
@@ -1037,7 +1036,6 @@ class GcsServer:
         its journaled spec. Restarts spent on recovery are free — the
         actor didn't crash, the GCS did."""
         await asyncio.sleep(GLOBAL_CONFIG.gcs_actor_recovery_grace_s)
-        loop = asyncio.get_running_loop()
         for aid in list(self._recovering):
             self._recovering.discard(aid)
             rec = self.actors.get(aid)
@@ -1047,10 +1045,10 @@ class GcsServer:
                         "(raylet never reclaimed it)", aid.hex()[:12])
             rec.address = None
             self._journal_actor(rec)
-            loop.create_task(self._place_actor(rec))
+            rpc.spawn(self._place_actor(rec))
         for pg in self.placement_groups.values():
             if pg.state in (PG_PENDING, PG_RESCHEDULING):
-                loop.create_task(self._place_pg(pg))
+                rpc.spawn(self._place_pg(pg))
 
     def _mark_dirty(self):
         self._dirty = True
@@ -1197,9 +1195,7 @@ class GcsServer:
             if conn.closed:
                 dead.append(conn)
                 continue
-            asyncio.get_running_loop().create_task(
-                conn.notify_async("publish", [channel, data])
-            )
+            rpc.spawn(conn.notify_async("publish", [channel, data]))
         for c in dead:
             self.subs.get(channel, set()).discard(c)
 
@@ -1268,7 +1264,7 @@ class GcsServer:
             # cycling its GCS link must not kill its fresh registration).
             if self._raylet_clients.get(node_id) is not conn:
                 return
-            asyncio.get_running_loop().create_task(self._mark_node_dead(node_id))
+            rpc.spawn(self._mark_node_dead(node_id))
 
         return on_close
 
@@ -1400,7 +1396,7 @@ class GcsServer:
                     pg.state = PG_RESCHEDULING
                     self._journal_pg(pg)
                     self._publish("placement_groups", [pg.to_wire()])
-                    asyncio.get_running_loop().create_task(self._place_pg(pg))
+                    rpc.spawn(self._place_pg(pg))
         # Actors on that node die (and maybe restart elsewhere).
         for rec in list(self.actors.values()):
             if rec.address and rec.address[2] == node_id and rec.state in (
@@ -1453,7 +1449,7 @@ class GcsServer:
         rec = ActorRecord(actor_id, spec, name=name)
         self.actors[actor_id] = rec
         fut = self._journal_actor(rec)
-        asyncio.get_running_loop().create_task(self._place_actor(rec))
+        rpc.spawn(self._place_actor(rec))
         await self._journal_wait(fut)
         return {"ok": True}
 
@@ -1799,7 +1795,7 @@ class GcsServer:
             return {"ok": False, "error": f"bad strategy {rec.strategy!r}"}
         self.placement_groups[pg_id] = rec
         fut = self._journal_pg(rec)
-        asyncio.get_running_loop().create_task(self._place_pg(rec))
+        rpc.spawn(self._place_pg(rec))
         await self._journal_wait(fut)
         return {"ok": True}
 
@@ -2105,10 +2101,8 @@ class GcsServer:
         for nid in nodes:
             raylet = self._raylet_clients.get(nid)
             if raylet is not None and not raylet.closed:
-                asyncio.get_running_loop().create_task(
-                    raylet.call_async("free_local_object", oid_bytes,
-                                      timeout=10)
-                )
+                rpc.spawn(raylet.call_async("free_local_object", oid_bytes,
+                                            timeout=10))
         await self._journal_wait(fut)
         return True
 

@@ -293,7 +293,7 @@ class GcsStandby:
             # bound promotion replay the same way the primary bounds
             # restart replay: periodic local compaction
             self._compacting = True
-            asyncio.get_running_loop().create_task(self._compact_async())
+            rpc.spawn(self._compact_async())
         return True
 
     async def _compact_async(self):

@@ -103,6 +103,10 @@ class SharedMemoryStore:
         self._mm = mm
         self._view = memoryview(mm)
         self._lib = _load()
+        # leak ledger (r20): oids THIS client created and has not yet
+        # sealed/aborted — a non-empty set at teardown is a held creator
+        # pin (the block can never be evicted or freed)
+        self._unsealed: set = set()
 
     # -- lifecycle --
     @classmethod
@@ -160,6 +164,7 @@ class SharedMemoryStore:
             raise StoreFullError("object table full (too many objects)")
         if off < 0:
             raise RuntimeError(f"store create failed rc={off}")
+        self._unsealed.add(oid.binary())
         return self._view[off : off + size]
 
     def seal(self, oid: ObjectID):
@@ -168,6 +173,7 @@ class SharedMemoryStore:
         rc = self._lib.rt_store_seal(self._base, oid.binary())
         if rc != 0:
             raise RuntimeError(f"seal failed for {oid.hex()}")
+        self._unsealed.discard(oid.binary())
 
     def abort(self, oid: ObjectID):
         """Abandon a created-but-unsealed buffer (call from the flow that
@@ -177,6 +183,7 @@ class SharedMemoryStore:
         race the free — which is why the release happens here too."""
         if not self._base:
             return
+        self._unsealed.discard(oid.binary())
         if self._lib.rt_store_abort(self._base, oid.binary()) == 0:
             self._lib.rt_store_release(self._base, oid.binary())
 
@@ -239,6 +246,12 @@ class SharedMemoryStore:
         buf = ctypes.create_string_buffer(16 * max_n)
         n = self._lib.rt_store_evictable(self._base, buf, max_n)
         return [ObjectID(buf.raw[i * 16 : (i + 1) * 16]) for i in range(n)]
+
+    @property
+    def unsealed_creates(self) -> int:
+        """Created-but-not-yet-sealed/aborted objects from THIS client
+        (leak ledger input: must be zero at clean shutdown)."""
+        return len(self._unsealed)
 
     def stats(self) -> dict:
         if not self._base:

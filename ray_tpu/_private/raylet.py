@@ -598,7 +598,7 @@ class Raylet:
         if self._stopping or conn is not self.gcs:
             return  # superseded conn (a re-registration already replaced it)
         logger.warning("GCS connection lost; reconnecting...")
-        asyncio.get_running_loop().create_task(self._gcs_reconnect_loop())
+        rpc.spawn(self._gcs_reconnect_loop())
 
     async def _gcs_reconnect_loop(self):
         if getattr(self, "_gcs_reconnecting", False):
@@ -2254,11 +2254,11 @@ class Raylet:
             if view is None:
                 return False  # not in memory there (e.g. spilled)
             size = view.nbytes
+            t0 = time.perf_counter()
+            chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
             buf = await self._create_local_with_spill(oid, size)
             if buf is None:
                 return self.store.contains(oid)
-            t0 = time.perf_counter()
-            chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
             try:
                 for off in range(0, size, chunk):
                     n = min(chunk, size - off)
@@ -2266,6 +2266,18 @@ class Raylet:
                     self._transfer_bytes_in += n
                     # big copies must not starve heartbeats/pulls
                     await asyncio.sleep(0)
+            except BaseException as e:
+                # BaseException: CancelledError at the sleep must also
+                # abort, or the unsealed pin leaks until restart (R14)
+                try:
+                    self.store.abort(oid)
+                except Exception:
+                    pass
+                if not isinstance(e, Exception):
+                    raise
+                logger.warning("same-host shm pull of %s failed: %r",
+                               oid.hex()[:12], e)
+                return False
             finally:
                 del buf
             self.store.seal(oid)
@@ -2284,10 +2296,6 @@ class Raylet:
         except Exception as e:
             logger.warning("same-host shm pull of %s failed: %r",
                            oid.hex()[:12], e)
-            try:
-                self.store.abort(oid)
-            except Exception:
-                pass
             return False
         finally:
             if view is not None:
@@ -2309,8 +2317,12 @@ class Raylet:
                 "read_object_meta", oid.binary(),
                 timeout=float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s),
             )
-        except Exception:
-            self._peer_pool.release(addr, conn, discard=True)
+        except BaseException as e:
+            # cancellation must hand the conn back too (R14); only a
+            # real call failure taints it
+            self._peer_pool.release(addr, conn, discard=isinstance(e, Exception))
+            if not isinstance(e, Exception):
+                raise
             return None
         self._peer_pool.release(addr, conn)
         return meta
@@ -2334,188 +2346,195 @@ class Raylet:
         buf = await self._create_local_with_spill(oid, size)
         if buf is None:
             return self.store.contains(oid)
-        t_create = time.perf_counter() - t_create
-        chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
-        sink_target = _PullSink(buf, size=size, chunk=chunk)
-        # Deposit sink: when the native engine carries this process's
-        # peer connections, chunk payloads stream STRAIGHT off the
-        # socket into `buf` (frames are tagged with this token) — the
-        # kernel's recv copy is the only receive-side copy. On the
-        # asyncio fallback the frames arrive inline and sink_target
-        # copies them into place instead.
-        token = int.from_bytes(os.urandom(7), "big") + 1
-        # available() may compile the shim on first call — off-loop (R7)
-        native_sink = bool(GLOBAL_CONFIG.native_wire and
-                           await asyncio.to_thread(_conduit.available))
-        if native_sink:
-            _conduit.Engine.get().sink_register(token, buf)
-        self._transfers[token] = sink_target
-        # broadcast tree: landed ranges of this in-progress pull are now
-        # servable onward to child pullers (read_object_chunks/meta)
-        self._partial_serves[oid.binary()] = sink_target
-        del buf
-        ranges = _collections.deque(
-            (off, min(chunk, size - off)) for off in range(0, size, chunk)
-        )
-        total_ranges = len(ranges)
-        done = [0]
-        landed = sink_target.landed
-        window = max(1, int(GLOBAL_CONFIG.object_transfer_window))
-        timeout_s = float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s)
-        chunk_tries = 1 + max(
-            0, int(GLOBAL_CONFIG.object_transfer_chunk_retries)
-        )
-        t0 = time.perf_counter()
-
-        async def fetch_batch(conn, todo):
-            """One streamed batch request: the peer pushes each chunk as
-            a raw frame (deposited natively or copied inline by
-            _on_obj_chunk), then replies — ordered delivery means every
-            frame of the batch precedes the reply, so arrival is checked
-            against the ledger right after."""
-            reply = await conn.call_async(
-                "read_object_chunks",
-                [oid.binary(), [[o, n] for o, n in todo], token],
-                timeout=timeout_s,
+        # Everything from here through the transfer loop runs under
+        # one BaseException guard: the unsealed pin (and, once
+        # registered, the sink / partial-serve entries) must be
+        # released on ANY exit, including cancellation (R13/R14).
+        sink_target = None
+        token = 0
+        native_sink = False
+        try:
+            t_create = time.perf_counter() - t_create
+            chunk = int(GLOBAL_CONFIG.object_transfer_chunk_bytes)
+            sink_target = _PullSink(buf, size=size, chunk=chunk)
+            # Deposit sink: when the native engine carries this process's
+            # peer connections, chunk payloads stream STRAIGHT off the
+            # socket into `buf` (frames are tagged with this token) — the
+            # kernel's recv copy is the only receive-side copy. On the
+            # asyncio fallback the frames arrive inline and sink_target
+            # copies them into place instead.
+            token = int.from_bytes(os.urandom(7), "big") + 1
+            # available() may compile the shim on first call — off-loop (R7)
+            native_sink = bool(GLOBAL_CONFIG.native_wire and
+                               await asyncio.to_thread(_conduit.available))
+            if native_sink:
+                _conduit.Engine.get().sink_register(token, buf)
+            self._transfers[token] = sink_target
+            # broadcast tree: landed ranges of this in-progress pull are now
+            # servable onward to child pullers (read_object_chunks/meta)
+            self._partial_serves[oid.binary()] = sink_target
+            del buf
+            ranges = _collections.deque(
+                (off, min(chunk, size - off)) for off in range(0, size, chunk)
             )
-            if reply is None:
-                raise _LocationMiss(oid.hex())
+            total_ranges = len(ranges)
+            done = [0]
+            landed = sink_target.landed
+            window = max(1, int(GLOBAL_CONFIG.object_transfer_window))
+            timeout_s = float(GLOBAL_CONFIG.object_transfer_chunk_timeout_s)
+            chunk_tries = 1 + max(
+                0, int(GLOBAL_CONFIG.object_transfer_chunk_retries)
+            )
+            t0 = time.perf_counter()
 
-        async def fetch_legacy(conn, todo):
-            """Per-chunk fallback for peers without the batch endpoint."""
-            for off, n in todo:
-                def sink(meta, mv, _off=off, _n=n):
-                    if len(mv) != _n:
-                        raise ValueError("chunk size mismatch")
-                    if sink_target.write(_off, mv):
-                        sink_target.record(_off, _n)
-
-                meta = await conn.call_raw_async(
-                    "read_object_chunk_raw",
-                    [oid.binary(), off, n, token], sink,
+            async def fetch_batch(conn, todo):
+                """One streamed batch request: the peer pushes each chunk as
+                a raw frame (deposited natively or copied inline by
+                _on_obj_chunk), then replies — ordered delivery means every
+                frame of the batch precedes the reply, so arrival is checked
+                against the ledger right after."""
+                reply = await conn.call_async(
+                    "read_object_chunks",
+                    [oid.binary(), [[o, n] for o, n in todo], token],
                     timeout=timeout_s,
                 )
-                if meta is None:
+                if reply is None:
                     raise _LocationMiss(oid.hex())
-                if native_sink:
-                    sink_target.record(off, n)
 
-        async def run_peer(addr: str) -> bool:
-            """Drain ranges through one peer; True = no transport fault."""
-            try:
-                conn = await self._peer_pool.acquire(addr)
-            except Exception:
-                return False
-            conn.raw_notify["obj_chunk"] = self._on_obj_chunk
-            state = {"failed": False}
-            batch_sem = asyncio.Semaphore(2)  # double-buffered batches
-            tasks = []
+            async def fetch_legacy(conn, todo):
+                """Per-chunk fallback for peers without the batch endpoint."""
+                for off, n in todo:
+                    def sink(meta, mv, _off=off, _n=n):
+                        if len(mv) != _n:
+                            raise ValueError("chunk size mismatch")
+                        if sink_target.write(_off, mv):
+                            sink_target.record(_off, _n)
 
-            async def run_batch(batch):
-                self._pull_chunks_inflight += len(batch)
-                err = None
-                try:
-                    attempt = 0
-                    while attempt < chunk_tries:
-                        todo = [r for r in batch if landed.get(r[0]) != r[1]]
-                        if not todo:
-                            break
-                        attempt += 1
-                        if attempt > 1:
-                            # a chaos-dropped frame costs one timeout,
-                            # not the whole striped attempt
-                            self._transfer_chunk_retries += 1
-                        try:
-                            if state.get("legacy"):
-                                await fetch_legacy(conn, todo)
-                            else:
-                                await fetch_batch(conn, todo)
-                        except _LocationMiss as e:
-                            # the peer no longer HOLDS a copy: a
-                            # location miss, not a transport fault —
-                            # retrying this peer cannot help, its
-                            # pooled conn is healthy (keep it), and the
-                            # outer pull attempt refreshes locations
-                            err = e
-                            break
-                        except rpc.RpcError as e:
-                            if "unknown method" in str(e) and not (
-                                state.get("legacy")
-                            ):
-                                state["legacy"] = True  # pre-batch peer
-                                # the fallback probe must not burn a
-                                # retry: at chunk_retries=0 the legacy
-                                # path still gets its one attempt
-                                attempt -= 1
-                                continue
-                            err = e
-                            break
-                        except Exception as e:
-                            err = e
-                            if conn.closed:
-                                break
-                    missing = [
-                        r for r in batch if landed.get(r[0]) != r[1]
-                    ]
-                    if missing:
-                        state["failed"] = True
-                        # per-CAUSE verdict: only a batch whose failure
-                        # was NOT a pure location miss implicates the
-                        # transport (a concurrent batch may time out on
-                        # this same conn while another sees the miss)
-                        if not isinstance(err, _LocationMiss):
-                            state["transport_fault"] = True
-                        if not state.get("logged"):
-                            state["logged"] = True
-                            logger.warning(
-                                "batch fetch of %s from %s failed "
-                                "(%d/%d chunks missing): %r",
-                                oid.hex()[:12], addr, len(missing),
-                                len(batch), err,
-                            )
-                        ranges.extend(missing)  # survivors take over
-                    # landed chunks count exactly once, at their batch
-                    for off, n in batch:
-                        if landed.get(off) == n:
-                            done[0] += 1
-                            self._transfer_bytes_in += n
-                finally:
-                    self._pull_chunks_inflight -= len(batch)
-                    batch_sem.release()
-
-            try:
-                while ranges and not state["failed"]:
-                    batch = []
-                    while ranges and len(batch) < window:
-                        batch.append(ranges.popleft())
-                    if not batch:
-                        break
-                    await batch_sem.acquire()
-                    if state["failed"]:
-                        ranges.extend(batch)
-                        batch_sem.release()
-                        break
-                    tasks.append(
-                        asyncio.get_running_loop().create_task(
-                            run_batch(batch)
-                        )
+                    meta = await conn.call_raw_async(
+                        "read_object_chunk_raw",
+                        [oid.binary(), off, n, token], sink,
+                        timeout=timeout_s,
                     )
-                if tasks:
-                    await asyncio.gather(*tasks, return_exceptions=True)
-            finally:
-                # a lost-copy peer FAILED the pull (its ranges handed
-                # over to survivors) but its connection is perfectly
-                # healthy — discard only when some batch implicated the
-                # TRANSPORT (timeouts/errors that were not location
-                # misses), so a conn that both missed a copy and wedged
-                # still gets discarded
-                self._peer_pool.release(
-                    addr, conn,
-                    discard=bool(state.get("transport_fault")),
-                )
-            return not state["failed"]
+                    if meta is None:
+                        raise _LocationMiss(oid.hex())
+                    if native_sink:
+                        sink_target.record(off, n)
 
-        try:
+            async def run_peer(addr: str) -> bool:
+                """Drain ranges through one peer; True = no transport fault."""
+                state = {"failed": False}
+                batch_sem = asyncio.Semaphore(2)  # double-buffered batches
+                tasks = []
+                try:
+                    conn = await self._peer_pool.acquire(addr)
+                except Exception:
+                    return False
+                conn.raw_notify["obj_chunk"] = self._on_obj_chunk
+
+                async def run_batch(batch):
+                    self._pull_chunks_inflight += len(batch)
+                    err = None
+                    try:
+                        attempt = 0
+                        while attempt < chunk_tries:
+                            todo = [r for r in batch if landed.get(r[0]) != r[1]]
+                            if not todo:
+                                break
+                            attempt += 1
+                            if attempt > 1:
+                                # a chaos-dropped frame costs one timeout,
+                                # not the whole striped attempt
+                                self._transfer_chunk_retries += 1
+                            try:
+                                if state.get("legacy"):
+                                    await fetch_legacy(conn, todo)
+                                else:
+                                    await fetch_batch(conn, todo)
+                            except _LocationMiss as e:
+                                # the peer no longer HOLDS a copy: a
+                                # location miss, not a transport fault —
+                                # retrying this peer cannot help, its
+                                # pooled conn is healthy (keep it), and the
+                                # outer pull attempt refreshes locations
+                                err = e
+                                break
+                            except rpc.RpcError as e:
+                                if "unknown method" in str(e) and not (
+                                    state.get("legacy")
+                                ):
+                                    state["legacy"] = True  # pre-batch peer
+                                    # the fallback probe must not burn a
+                                    # retry: at chunk_retries=0 the legacy
+                                    # path still gets its one attempt
+                                    attempt -= 1
+                                    continue
+                                err = e
+                                break
+                            except Exception as e:
+                                err = e
+                                if conn.closed:
+                                    break
+                        missing = [
+                            r for r in batch if landed.get(r[0]) != r[1]
+                        ]
+                        if missing:
+                            state["failed"] = True
+                            # per-CAUSE verdict: only a batch whose failure
+                            # was NOT a pure location miss implicates the
+                            # transport (a concurrent batch may time out on
+                            # this same conn while another sees the miss)
+                            if not isinstance(err, _LocationMiss):
+                                state["transport_fault"] = True
+                            if not state.get("logged"):
+                                state["logged"] = True
+                                logger.warning(
+                                    "batch fetch of %s from %s failed "
+                                    "(%d/%d chunks missing): %r",
+                                    oid.hex()[:12], addr, len(missing),
+                                    len(batch), err,
+                                )
+                            ranges.extend(missing)  # survivors take over
+                        # landed chunks count exactly once, at their batch
+                        for off, n in batch:
+                            if landed.get(off) == n:
+                                done[0] += 1
+                                self._transfer_bytes_in += n
+                    finally:
+                        self._pull_chunks_inflight -= len(batch)
+                        batch_sem.release()
+
+                try:
+                    while ranges and not state["failed"]:
+                        batch = []
+                        while ranges and len(batch) < window:
+                            batch.append(ranges.popleft())
+                        if not batch:
+                            break
+                        await batch_sem.acquire()
+                        if state["failed"]:
+                            ranges.extend(batch)
+                            batch_sem.release()
+                            break
+                        tasks.append(
+                            asyncio.get_running_loop().create_task(
+                                run_batch(batch)
+                            )
+                        )
+                    if tasks:
+                        await asyncio.gather(*tasks, return_exceptions=True)
+                finally:
+                    # a lost-copy peer FAILED the pull (its ranges handed
+                    # over to survivors) but its connection is perfectly
+                    # healthy — discard only when some batch implicated the
+                    # TRANSPORT (timeouts/errors that were not location
+                    # misses), so a conn that both missed a copy and wedged
+                    # still gets discarded
+                    self._peer_pool.release(
+                        addr, conn,
+                        discard=bool(state.get("transport_fault")),
+                    )
+                return not state["failed"]
+
             survivors = list(peers)
             while ranges and survivors:
                 done_before = done[0]
@@ -2535,7 +2554,8 @@ class Raylet:
                 self._partial_serves.pop(oid.binary(), None)
             if native_sink:
                 _conduit.Engine.get().sink_unregister(token)
-            sink_target.close()
+            if sink_target is not None:
+                sink_target.close()
             try:
                 self.store.abort(oid)
             except Exception:
@@ -2933,13 +2953,20 @@ class Raylet:
             except Exception:
                 return None
 
-        out = {"task_inline_hits": 0, "task_inline_bytes": 0}
+        out = {"task_inline_hits": 0, "task_inline_bytes": 0,
+               "worker_unsealed_creates": 0,
+               "worker_window_outstanding": 0}
         for r in await asyncio.gather(*(one(c) for c in conns)):
             if r:
                 out["task_inline_hits"] += int(r.get("task_inline_hits", 0))
                 out["task_inline_bytes"] += int(
                     r.get("task_inline_bytes", 0)
                 )
+                lk = r.get("leaks") or {}
+                out["worker_unsealed_creates"] += int(
+                    lk.get("unsealed_creates", 0))
+                out["worker_window_outstanding"] += int(
+                    lk.get("actor_window_outstanding", 0))
         self._task_plane_cache = (now, out)
         return out
 
@@ -2979,6 +3006,7 @@ class Raylet:
         return out
 
     async def rpc_node_stats(self, conn, _):
+        task_plane = await self._task_plane_stats()
         return {
             "node_id": self.node_id.hex(),
             # live label view (startup labels + GCS-side patches like a
@@ -3000,7 +3028,24 @@ class Raylet:
             "gcs_cache": dict(self._gcs_cache_stats,
                               loc_entries=len(self._loc_cache),
                               node_entries=len(self.cluster_nodes)),
-            "task_plane": await self._task_plane_stats(),
+            "task_plane": task_plane,
+            # resource-lifecycle leak ledger (r20): the runtime
+            # counterpart of raylint R13 — every counter must return to
+            # zero at quiesce (test teardown asserts it via
+            # test_utils.assert_no_leaks). A persistently non-zero entry
+            # means an acquire escaped its release path at runtime.
+            "leaks": {
+                "open_sinks": len(self._transfers),
+                "partial_serves": len(self._partial_serves),
+                "held_creator_pins": (self.store.unsealed_creates
+                                      if self.store else 0),
+                "unreleased_pool_conns":
+                    self._peer_pool.stats()["in_use"],
+                "worker_unsealed_creates":
+                    task_plane.get("worker_unsealed_creates", 0),
+                "worker_window_outstanding":
+                    task_plane.get("worker_window_outstanding", 0),
+            },
             # gang membership of this node (mesh-group compute plane):
             # rendezvous epoch, lifecycle state, steps, last failure
             "mesh_groups": await self._mesh_group_stats(),

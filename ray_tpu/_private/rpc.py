@@ -104,6 +104,36 @@ async def open_connection(addr: str):
     return await asyncio.open_connection(host, int(port))
 
 
+# Background-task keeper (r20/R15): the event loop holds only a weak
+# reference to tasks, so a fire-and-forget ``create_task(...)`` can be
+# garbage-collected mid-flight and silently swallows its exception.
+# ``spawn`` pins the task until done and logs non-cancellation failures.
+_BG_TASKS: set = set()
+
+
+def _reap_bg(t: "asyncio.Task"):
+    _BG_TASKS.discard(t)
+    if t.cancelled():
+        return
+    exc = t.exception()
+    if exc is not None:
+        logging.getLogger(__name__).error(
+            "background task %s failed", t.get_name(), exc_info=exc
+        )
+
+
+def spawn(coro, name: Optional[str] = None) -> "asyncio.Task":
+    """``create_task`` with a strong reference and an exception reaper.
+
+    Use for fire-and-forget work on the IO loop; the returned task may
+    still be stored/awaited/cancelled like any other.
+    """
+    t = asyncio.get_running_loop().create_task(coro, name=name)
+    _BG_TASKS.add(t)
+    t.add_done_callback(_reap_bg)
+    return t
+
+
 class EventLoopThread:
     """One per process: the IO loop everything in-process shares."""
 
@@ -228,9 +258,7 @@ class Connection:
                 # replies, the epoch the server is serving at
                 epoch = msg[5] if len(msg) > 5 else None
                 if kind == _REQUEST:
-                    asyncio.get_running_loop().create_task(
-                        self._handle(seqno, method, data, rid, epoch)
-                    )
+                    spawn(self._handle(seqno, method, data, rid, epoch))
                 elif kind == _NOTIFY:
                     fn = self.sync_notify.get(method)
                     if fn is not None:
@@ -241,9 +269,7 @@ class Connection:
                                 "sync notify handler %s failed", method
                             )
                     else:
-                        asyncio.get_running_loop().create_task(
-                            self._handle(None, method, data)
-                        )
+                        spawn(self._handle(None, method, data))
                 elif kind in (_REPLY, _ERROR):
                     if epoch is not None:
                         self.peer_epoch = epoch

@@ -23,6 +23,82 @@ from typing import Dict, List, Optional
 from ray_tpu._private import chaos
 
 
+def assert_no_leaks(cluster=None, timeout_s: float = 10.0,
+                    check_intents: bool = True):
+    """Teardown helper for the r20 resource-lifecycle ledger: poll every
+    alive raylet's ``node_stats["leaks"]`` section (open transfer sinks,
+    held creator pins, unreleased peer-pool connections, partial serves,
+    worker-side unsealed creates and actor-window credits) until every
+    counter is zero, and — with ``check_intents`` — assert the GCS
+    autoscaler-intent table is empty (a leftover intent is a provisioning
+    WAL entry whose heal never completed or cleaned up).
+
+    Polls because the raylet's worker fan-out is cached ~2s and
+    background release paths (pool returns, sink unregisters) may still
+    be draining when the workload's last result lands. Nodes whose
+    raylet process has exited (chaos kills) are skipped — their ledger
+    died with them.
+    """
+    import ray_tpu._private.rpc as rpc_mod
+    from ray_tpu._private import worker as worker_mod
+
+    if cluster is None:
+        cluster = worker_mod.global_worker.cluster
+        assert cluster is not None, "no cluster to audit (not connected?)"
+    # accept both the cluster_utils.Cluster wrapper and the impl-level
+    # node.Cluster that ray_tpu.init() stores on the global worker
+    impl = getattr(cluster, "_impl", cluster)
+
+    deadline = time.monotonic() + timeout_s
+    last: Dict[str, Dict] = {}
+    while True:
+        last = {}
+        clean = True
+        for n in impl.nodes.values():
+            if n.proc.poll() is not None:
+                continue
+            try:
+                client = rpc_mod.Client.connect(n.raylet_addr, timeout=5)
+                try:
+                    stats = client.call("node_stats", None, timeout=5)
+                finally:
+                    client.close()
+            except Exception as e:
+                clean = False
+                last[n.node_id.hex()] = {"unreachable": str(e)}
+                continue
+            leaks = dict(stats.get("leaks") or {})
+            last[n.node_id.hex()] = leaks
+            if any(leaks.values()):
+                clean = False
+        # the connected driver's own ledger, checked directly (it is
+        # also in the raylet fan-out, but that view is ~2s stale)
+        cw = getattr(worker_mod.global_worker, "core_worker", None)
+        if cw is not None:
+            mine = cw.leak_stats()
+            last["driver"] = mine
+            if any(mine.values()):
+                clean = False
+        if check_intents:
+            try:
+                client = rpc_mod.Client.connect(impl.gcs_addr, timeout=5)
+                try:
+                    intents = client.call("autoscaler_intent_table",
+                                          None, timeout=5) or {}
+                finally:
+                    client.close()
+            except Exception as e:
+                intents = {"unreachable": str(e)}
+            if intents:
+                clean = False
+                last["gcs_intents"] = dict(intents)
+        if clean:
+            return
+        if time.monotonic() >= deadline:
+            raise AssertionError(f"resource leaks at teardown: {last}")
+        time.sleep(0.25)
+
+
 @contextlib.contextmanager
 def network_chaos(spec: Dict, role: str = "driver"):
     """Export a chaos spec to the environment (inherited by every daemon

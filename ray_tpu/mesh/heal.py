@@ -185,8 +185,18 @@ class GangHealer:
             }
             self._put_intent(mg.name, rec)
             handle = self.provider.create_slice()
-            rec = dict(rec, state="PENDING", slice=handle["name"])
-            self._put_intent(mg.name, rec)
+            try:
+                rec = dict(rec, state="PENDING", slice=handle["name"])
+                self._put_intent(mg.name, rec)
+            except BaseException:
+                # the slice exists but its name never reached the
+                # journal: nothing could ever adopt or delete it, so
+                # release it before surfacing the failure (R13)
+                try:
+                    self.provider.delete_slice(handle["name"])
+                except Exception:
+                    pass
+                raise
         except Exception:
             # provisioning refused (stockout past retries, quota): the
             # gang still surfaces the typed RankFailedError; heal()
@@ -237,14 +247,24 @@ class GangHealer:
                 )
         if handle is None:
             handle = self.provider.create_slice()
-            self._put_intent(mg.name, {
-                "gang": mg.name,
-                "state": "PENDING",
-                "slice": handle["name"],
-                "dead_node": (pend or {}).get("dead_node", ""),
-                "hosts": mg.hosts,
-                "ts": time.time(),
-            })
+            try:
+                self._put_intent(mg.name, {
+                    "gang": mg.name,
+                    "state": "PENDING",
+                    "slice": handle["name"],
+                    "dead_node": (pend or {}).get("dead_node", ""),
+                    "hosts": mg.hosts,
+                    "ts": time.time(),
+                })
+            except BaseException:
+                # un-journaled slice: a healer restart would file a
+                # SECOND one (double-provision) and nothing would ever
+                # delete this one — release before propagating (R13)
+                try:
+                    self.provider.delete_slice(handle["name"])
+                except Exception:
+                    pass
+                raise
         if pend is None:
             pend = {"dead_node": "", "t_failure": time.monotonic()}
             self._pending[mg.name] = pend

@@ -14,7 +14,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private import chaos, rpc
-from ray_tpu._private.test_utils import network_chaos
+from ray_tpu._private.test_utils import assert_no_leaks, network_chaos
 from ray_tpu.cluster_utils import Cluster
 
 
@@ -311,6 +311,10 @@ def test_chaos_mid_pull_peer_death_refetches_from_survivor():
         assert meta is not None and meta["size"] >= arr.nbytes
         cli_b.close()
         cli_c.close()
+        # the r20 leak ledger must drain to zero after recovery: the
+        # dead peer's sink/pin/pool-conn state was torn down, not leaked
+        # (the killed node itself is skipped — its ledger died with it)
+        assert_no_leaks(c, timeout_s=15)
     finally:
         c.shutdown()
 

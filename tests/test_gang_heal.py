@@ -24,6 +24,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private.protocol import LABEL_HOST, LABEL_SLICE
+from ray_tpu._private.test_utils import assert_no_leaks
 from ray_tpu._private.worker import require_connected
 from ray_tpu.cloud_provider import MockTpuApi, QueuedResourceProvider
 from ray_tpu.cluster_utils import Cluster
@@ -194,6 +195,9 @@ def test_rank_death_files_one_slice_and_heals_full_shape(tmp_path):
                 got.append(float(loss))
             assert got == expect, (got, expect)  # bitwise continuation
             assert result["mttr_s"] > 0 and result["recover_s"] > 0
+            # r20 leak ledger: the heal left no open sinks, creator
+            # pins, pooled conns, window credits or orphaned intents
+            assert_no_leaks(c, timeout_s=15)
         finally:
             mg.shutdown()
     finally:

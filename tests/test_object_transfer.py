@@ -26,6 +26,7 @@ from ray_tpu._private import conduit, rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.test_utils import assert_no_leaks
 from ray_tpu.cluster_utils import Cluster
 
 
@@ -259,6 +260,9 @@ def test_windowed_striped_pull_from_two_peers():
         ) == _checksum_via_chunks(cli_head, ref.binary(), meta["size"])
         for cl in (cli_head, cli2, cli3):
             cl.close()
+        # r20 leak ledger: sinks, creator pins and pooled conns all
+        # returned once the pulls quiesced
+        assert_no_leaks(c)
     finally:
         c.shutdown()
 

@@ -243,6 +243,65 @@ CORPUS = {
             return GLOBAL_CONFIG.get("live_knob_ms")
         """,
     ),
+    # Lifecycle rules (pass 4, CFG-driven): registered acquires must be
+    # released on every path / survive cancellation / keep a task ref.
+    "R13": (
+        "_private/store_io.py",
+        # the commit=False path falls through holding the creator pin
+        """
+        def write(self, oid, data, commit):
+            buf = self.store.create_buffer(oid, len(data))
+            buf[:] = data
+            if commit:
+                self.store.seal(oid)
+        """,
+        """
+        def write(self, oid, data):
+            buf = self.store.create_buffer(oid, len(data))
+            try:
+                buf[:] = data
+            except BaseException:
+                self.store.abort(oid)
+                raise
+            self.store.seal(oid)
+        """,
+    ),
+    "R14": (
+        "_private/store_io.py",
+        # a cancellation delivered at the await leaks the pin: nothing
+        # protects it yet
+        """
+        async def push(self, oid, data):
+            buf = self.store.create_buffer(oid, len(data))
+            await self.replicate(oid)
+            self.store.seal(oid)
+        """,
+        """
+        async def push(self, oid, data):
+            buf = self.store.create_buffer(oid, len(data))
+            try:
+                await self.replicate(oid)
+            except BaseException:
+                self.store.abort(oid)
+                raise
+            self.store.seal(oid)
+        """,
+    ),
+    "R15": (
+        "_private/pump.py",
+        # fire-and-forget: the loop only keeps a weak ref, the task can
+        # be GC'd mid-flight and its exception is never observed
+        """
+        import asyncio
+        async def start(self):
+            asyncio.create_task(self._pump())
+        """,
+        """
+        import asyncio
+        async def start(self):
+            self._task = asyncio.create_task(self._pump())
+        """,
+    ),
 }
 
 
@@ -977,6 +1036,171 @@ def test_contracts_lock_schema(tmp_path):
     for plane in ("gcs", "raylet", "worker"):
         assert repo_lock["planes"][plane]["handlers"], plane
     assert all("read" in v for v in repo_lock["knobs"].values())
+
+
+# ------------------------------------------- lifecycle corners (r20)
+# CFG corner-case corpus for the pass-4 flow analysis: the shapes the
+# per-route finally duplication, cancellation edges and escape
+# (ownership-transfer) tracking must each get right.
+
+_LC_PATH = "_private/lc_fixture.py"
+
+
+def _lc(src):
+    findings, _ = lint_source(textwrap.dedent(src), _LC_PATH)
+    return findings
+
+
+def test_lc_release_in_finally_vs_else():
+    # release only on the else route: the except route swallows the
+    # error and RETURNS still holding the sink registration
+    bad = """
+    def f(self, oid):
+        token = self.sink_register(oid)
+        try:
+            self.pump(token)
+        except Exception:
+            return False
+        else:
+            self.sink_unregister(oid)
+        return True
+    """
+    assert any(f.rule == "R13" for f in _lc(bad)), _lc(bad)
+    good = """
+    def f(self, oid):
+        token = self.sink_register(oid)
+        try:
+            self.pump(token)
+        finally:
+            self.sink_unregister(oid)
+    """
+    assert _lc(good) == [], [f.as_dict() for f in _lc(good)]
+
+
+def test_lc_with_acquire_owned_by_context_manager():
+    # `with pool.acquire(...) as conn` — the context manager owns the
+    # release; no pairing demanded (sync and async forms)
+    for src in (
+        """
+        def f(self, addr):
+            with self.pool.acquire(addr) as conn:
+                self.use(conn)
+        """,
+        """
+        async def f(self, addr):
+            async with await self.pool.acquire(addr) as conn:
+                await self.use(conn)
+        """,
+    ):
+        assert _lc(src) == [], [f.as_dict() for f in _lc(src)]
+    # ...but a bare acquire with no release IS a leak
+    bad = """
+    def f(self, addr):
+        conn = self.pool.acquire(addr)
+        self.use(conn)
+    """
+    assert any(f.rule == "R13" for f in _lc(bad)), _lc(bad)
+
+
+def test_lc_ownership_transfer_counts_as_release():
+    # handing the slice name to the durable intent table transfers
+    # ownership (the healer adopts it on restart) — not a leak
+    good = """
+    def g(self, gang, spec):
+        handle = self.provider.create_slice()
+        self._put_intent(gang, {"slice": handle})
+    """
+    assert _lc(good) == [], [f.as_dict() for f in _lc(good)]
+    # same for a window credit escaping into the streamed-push path
+    win = """
+    async def h(self, win, aid):
+        await win.acquire()
+        self._push_actor_stream(aid)
+    """
+    assert _lc(win) == [], [f.as_dict() for f in _lc(win)]
+    # no transfer, no release: the slice leaks
+    bad = """
+    def g(self, gang, spec):
+        handle = self.provider.create_slice()
+        self.record(handle)
+    """
+    assert any(f.rule == "R13" for f in _lc(bad)), _lc(bad)
+
+
+def test_lc_double_release_on_loop_back_edge():
+    bad = """
+    def f(self, oid, n):
+        buf = self.store.create_buffer(oid, n)
+        for i in range(n):
+            self.store.seal(oid)
+    """
+    assert any(f.rule == "R13" and "double release" in f.message
+               for f in _lc(bad)), _lc(bad)
+
+
+def test_lc_return_inside_finally_swallows_exception():
+    # CPython semantics: `return` in a finally swallows the in-flight
+    # exception — the abort on that route still pairs the acquire
+    good = """
+    def f(self, oid, n):
+        buf = self.store.create_buffer(oid, n)
+        try:
+            self.fill(buf)
+        finally:
+            self.store.abort(oid)
+            return None
+    """
+    assert _lc(good) == [], [f.as_dict() for f in _lc(good)]
+
+
+def test_lc_acquire_in_comprehension_is_direct_finding():
+    bad = """
+    def f(self, oids):
+        bufs = [self.store.create_buffer(o, 16) for o in oids]
+        return bufs
+    """
+    assert any(f.rule == "R13" and "comprehension" in f.message
+               for f in _lc(bad)), _lc(bad)
+
+
+def test_lc_leak_invisible_to_r1_r12():
+    """Acceptance: the lifecycle leak is invisible to every pre-pass-4
+    rule — only the CFG flow analysis can see it."""
+    bad = """
+    def f(self, oid):
+        token = self.sink_register(oid)
+        try:
+            self.pump(token)
+        except Exception:
+            return False
+        else:
+            self.sink_unregister(oid)
+        return True
+    """
+    old_rules = [r for r in RULES if r not in ("R13", "R14", "R15")]
+    old, _ = lint_source(textwrap.dedent(bad), _LC_PATH, rules=old_rules)
+    assert old == [], [f.as_dict() for f in old]
+    full = _lc(bad)
+    assert any(f.rule == "R13" for f in full), full
+
+
+def test_lifecycle_pass_wall_budget():
+    """The CFG pass must not blow up analyzer wall time: a full R1–R15
+    run over the whole tree stays within 2x an R1–R12-only run (plus
+    fixed slack for shared-box timing noise)."""
+    import os
+    import time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = ["ray_tpu", "tests", "tools"]
+    old_rules = [r for r in RULES if r not in ("R13", "R14", "R15")]
+    t0 = time.perf_counter()
+    lint_paths(paths, rules=old_rules, root=root)
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    lint_paths(paths, root=root)
+    full = time.perf_counter() - t0
+    assert full <= 2.0 * base + 0.75, (full, base)
 
 
 def test_repo_is_raylint_clean():

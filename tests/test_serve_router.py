@@ -25,12 +25,16 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 from ray_tpu._private import chaos
+from ray_tpu._private.test_utils import assert_no_leaks
 
 
 @pytest.fixture
 def rt_serve():
     ray_tpu.init(num_cpus=6, object_store_memory=128 * 1024 * 1024)
     yield ray_tpu
+    # r20 leak ledger: every test in this suite must quiesce clean —
+    # no open sinks, held creator pins, pooled conns or window credits
+    assert_no_leaks()
     ray_tpu.shutdown()
 
 

@@ -1,0 +1,10 @@
+#!/usr/bin/env sh
+# Pre-commit gate: lint only the files touched vs HEAD (the project
+# index is still built over the whole tree — flow rules need the full
+# call graph), emitting SARIF for editor/CI ingestion. rc 1 on any
+# finding blocks the commit.
+#
+# Install:  ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
+set -e
+cd "$(dirname "$0")/.."
+exec python -m tools.raylint --changed HEAD --sarif ray_tpu tests tools

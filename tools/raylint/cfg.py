@@ -1,0 +1,551 @@
+"""raylint pass 4 (PR 20): per-function control-flow graphs.
+
+The lifecycle rules R13–R15 need to reason about *paths* — "every path
+from this ``create_buffer`` to function exit reaches exactly one
+``seal``/``abort``" is not a property of any single AST node.  This
+module builds a statement-granularity CFG per function, cheap enough to
+run lazily over only the functions the resource registry pre-filters
+(see ``rules._check_r13``), and precise where the repo's real leak
+shapes live:
+
+* **Normal edges** (``Node.succs``) follow statement order through
+  ``if``/``elif``/``else``, ``while``/``for`` (with ``break``/
+  ``continue``/``else`` and back edges; a literal ``while True:`` has
+  no fall-through exit), ``with``/``async with``, ``return`` and
+  ``raise``.  ``if`` edges carry a *guard* ``(ast.dump(test),
+  polarity)`` so the flow analysis can (a) follow only the branch
+  consistent with the conditions under which the resource was acquired
+  and (b) recognise ``if buf is None: return`` null-guards after a
+  nullable acquire.
+* **Exception edges** (``Node.esuccs``) exist on statements that can
+  raise — ``raise``/``assert`` and any statement whose *header*
+  expressions contain a call or await (pure assignments and jumps
+  cannot fail in ways this analysis cares about).  They route to each
+  live ``except`` handler of the enclosing ``try`` (a handler list
+  stops at a catch-all: bare / ``BaseException`` / ``Exception``),
+  then through enclosing ``finally`` blocks, then to the exceptional
+  exit ``xexit``.
+* **Cancellation edges** (``Node.csuccs``) exist on statements whose
+  header contains an ``await`` (``async for`` / ``async with``
+  headers count — their protocol calls are awaits).  They route like
+  exception edges **except** that only handlers catching
+  ``CancelledError`` apply: bare ``except``, ``BaseException``, or an
+  explicit ``CancelledError`` — ``except Exception`` does *not* stop a
+  cancellation (CancelledError subclasses BaseException since 3.8,
+  which is exactly why the PR 2 ``_pull_striped`` leak existed).
+* **finally** bodies are instantiated once per *continuation route*
+  (normal fall-through, each distinct exception/return/break/continue
+  unwinding target), the way CPython's compiler duplicates finally
+  bytecode.  A single shared instance would merge routes — state from
+  an exception path could flow into the normal continuation and vice
+  versa, manufacturing phantom double-release/leak paths through the
+  exact ``try/except: release; raise / finally`` shape the rules
+  recommend.  A ``finally`` whose every path ends abruptly
+  (return-inside-finally) swallows its route's continuation, matching
+  Python semantics.
+* Nodes created inside ``except`` handler bodies or ``finally``
+  bodies carry ``cleanup=True``.  The rules layer treats cleanup code
+  optimistically (its own may-raise points are not leak paths when a
+  release is straight-line-reachable) — otherwise every multi-line
+  cleanup handler would need its own nested try per line.
+* ``with`` bodies get no implicit handler edges: the overwhelming
+  context-manager population does not suppress exceptions, and
+  modelling suppression would hide real leak paths.  A ``with``
+  header *as* an acquire is recognised by the rules layer instead
+  (the context manager owns the release by construction).
+
+Everything is intraprocedural: a call is an opaque may-raise point.
+Ownership that crosses a function boundary is the rules layer's
+``escape`` concept (return the resource, store it on an object, hand
+it to a registered transfer call), not a CFG concern.
+
+Graphs are memoized on the pass-1 ``ProjectIndex`` (``cfg_for``), so
+the bench gate pays the build cost once per function per run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Node", "CFG", "build_cfg", "cfg_for", "stmt_has_await",
+           "stmt_may_raise", "header_exprs", "expr_walk"]
+
+#: edge guard: (ast.dump(test), polarity) — "this edge is taken when
+#: ``test`` evaluated to ``polarity``"
+Guard = Tuple[str, bool]
+
+
+class Node:
+    """One CFG node: a statement header, an except-handler entry, a
+    synthetic ``finally`` entry / loop join, or an exit."""
+
+    __slots__ = ("stmt", "kind", "succs", "esuccs", "csuccs", "idx",
+                 "cleanup")
+
+    def __init__(self, stmt: Optional[ast.AST], kind: str, idx: int,
+                 cleanup: bool = False):
+        self.stmt = stmt          # ast statement / ExceptHandler / None
+        self.kind = kind          # stmt|handler|finally|join|exit|xexit
+        self.succs: List[Tuple["Node", Optional[Guard]]] = []
+        self.esuccs: List["Node"] = []   # exception targets
+        self.csuccs: List["Node"] = []   # cancellation targets
+        self.idx = idx
+        self.cleanup = cleanup    # inside an except-handler/finally body
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        tag = type(self.stmt).__name__ if self.stmt is not None else "-"
+        return f"<Node {self.idx} {self.kind} {tag} L{self.lineno}>"
+
+
+class CFG:
+    __slots__ = ("fn", "nodes", "entry", "exit", "xexit", "by_stmt")
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: List[Node] = []
+        self.entry: Optional[Node] = None
+        self.exit: Optional[Node] = None    # normal completion
+        self.xexit: Optional[Node] = None   # uncaught exception
+        #: id(stmt) -> Node for statement/handler nodes
+        self.by_stmt: Dict[int, Node] = {}
+
+
+# ------------------------------------------------- header introspection
+
+def header_exprs(stmt: ast.AST) -> List[ast.expr]:
+    """The expressions a statement's CFG node evaluates itself (compound
+    statements evaluate only their header — bodies are separate nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, getattr(ast, "AsyncFor", ast.For))):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, getattr(ast, "AsyncWith", ast.With))):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    return [c for c in ast.iter_child_nodes(stmt)
+            if isinstance(c, ast.expr)]
+
+
+def expr_walk(exprs: List[ast.expr]):
+    """Walk expressions without entering lambda bodies (deferred code:
+    nothing in a lambda body runs when the statement does)."""
+    stack = [e for e in exprs if e is not None]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+#: call names (last dotted component) treated as non-raising.  The
+#: honest answer is "almost anything can raise" (``d.pop(k)`` without a
+#: default, ``deque.popleft()`` on empty), but those micro-failures are
+#: not the leak-shape failures R13/R14 hunt, and without this list every
+#: ``self._xs.pop(token, None)`` in a commit/cleanup sequence becomes
+#: its own unfixable phantom leak path.  Kept to container bookkeeping,
+#: clocks, and pure predicates — never I/O or RPC verbs.
+_SAFE_CALLS = frozenset({
+    "pop", "get", "discard", "add", "append", "appendleft", "popleft",
+    "update", "clear", "setdefault", "keys", "values", "items", "copy",
+    "close", "release_ref", "done", "cancelled", "cancel", "set",
+    "is_set", "perf_counter", "monotonic", "time", "len", "all", "any",
+    "min", "max", "abs", "bool", "isinstance", "hasattr", "id", "hex",
+    "range", "round", "enumerate", "zip",
+    # repo-idiomatic pure accessors (ObjectID.binary() mirrors .hex())
+    "binary",
+})
+
+
+def stmt_may_raise(stmt: ast.AST) -> bool:
+    """Can this node's own evaluation raise?  Restricted to statements
+    containing a non-``_SAFE_CALLS`` call or an await (plus
+    raise/assert): attribute access and arithmetic can raise too, but
+    flagging them would make every leak finding unfixable noise — the
+    repo's real leak paths all fail in a callee."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (getattr(ast, "AsyncFor", ()),
+                         getattr(ast, "AsyncWith", ()))):
+        return True  # implicit protocol awaits
+    for n in expr_walk(header_exprs(stmt)):
+        if isinstance(n, ast.Await):
+            return True
+        if isinstance(n, ast.Call):
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name not in _SAFE_CALLS:
+                return True
+    return False
+
+
+def stmt_has_await(stmt: ast.AST) -> bool:
+    """Is this node a suspension (= cancellation) point?"""
+    if isinstance(stmt, (getattr(ast, "AsyncFor", ()),
+                         getattr(ast, "AsyncWith", ()))):
+        return True
+    return any(isinstance(n, ast.Await)
+               for n in expr_walk(header_exprs(stmt)))
+
+
+# ------------------------------------------------------ handler classes
+
+class _HandlerKinds:
+    __slots__ = ("catches_cancel", "catch_all_exc")
+
+    def __init__(self, type_expr: Optional[ast.expr]):
+        names: List[str] = []
+
+        def collect(t):
+            if t is None:
+                names.append("<bare>")
+            elif isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    collect(el)
+            elif isinstance(t, ast.Attribute):
+                names.append(t.attr)      # asyncio.CancelledError -> last
+            elif isinstance(t, ast.Name):
+                names.append(t.id)
+            else:
+                names.append("?")
+
+        collect(type_expr)
+        self.catches_cancel = any(
+            n in ("<bare>", "BaseException", "CancelledError")
+            for n in names)
+        self.catch_all_exc = any(
+            n in ("<bare>", "BaseException", "Exception")
+            for n in names)
+
+
+# ------------------------------------------------------------- contexts
+
+class _Fin:
+    """One ``finally`` region: the finalbody AST plus one entry node
+    per distinct continuation route that unwinding registered while the
+    protected region was being built.  Each route gets its own copy of
+    the finalbody (built by ``_Builder._try`` after the protected
+    region), so dataflow state entering from an exception route cannot
+    exit onto the normal continuation or vice versa."""
+
+    __slots__ = ("builder", "routes")
+
+    def __init__(self, builder: "_Builder"):
+        self.builder = builder
+        #: frozenset(id(target)) -> (entry Node, [target Nodes])
+        self.routes: Dict[frozenset, Tuple[Node, List[Node]]] = {}
+
+    def route(self, targets: List[Node]) -> Node:
+        key = frozenset(id(t) for t in targets)
+        got = self.routes.get(key)
+        if got is None:
+            entry = self.builder._node(None, "finally")
+            got = (entry, list(targets))
+            self.routes[key] = got
+        return got[0]
+
+
+class _Try:
+    __slots__ = ("handlers", "state", "fin")
+
+    def __init__(self, handlers, fin: Optional[_Fin]):
+        self.handlers = handlers      # [(kinds, handler Node)]
+        self.state = "body"           # body | else | handler
+        self.fin = fin
+
+
+class _Loop:
+    __slots__ = ("head", "after")
+
+    def __init__(self, head: Node, after: Node):
+        self.head = head
+        self.after = after
+
+
+# -------------------------------------------------------------- builder
+
+#: frontier entry: a node whose next normal edge is dangling, plus the
+#: guard that edge should carry once connected
+_Frontier = List[Tuple[Node, Optional[Guard]]]
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.cfg = CFG(fn)
+        self.stack: List[object] = []
+        self.cleanup_depth = 0
+        self.cfg.exit = self._node(None, "exit")
+        self.cfg.xexit = self._node(None, "xexit")
+
+    def _node(self, stmt, kind) -> Node:
+        n = Node(stmt, kind, len(self.cfg.nodes),
+                 cleanup=self.cleanup_depth > 0)
+        self.cfg.nodes.append(n)
+        if stmt is not None and kind in ("stmt", "handler"):
+            self.cfg.by_stmt[id(stmt)] = n
+        return n
+
+    @staticmethod
+    def _connect(frontier: _Frontier, target: Node) -> None:
+        for n, guard in frontier:
+            n.succs.append((target, guard))
+
+    # -------------------------------------------------------- routing
+
+    def _route_exc(self, cancel: bool, depth: Optional[int] = None
+                   ) -> List[Node]:
+        if depth is None:
+            depth = len(self.stack)
+        targets: List[Node] = []
+        for i in range(depth - 1, -1, -1):
+            ctx = self.stack[i]
+            if not isinstance(ctx, _Try):
+                continue
+            if ctx.state == "body":
+                stopped = False
+                for kinds, hnode in ctx.handlers:
+                    if cancel and not kinds.catches_cancel:
+                        continue
+                    targets.append(hnode)
+                    if kinds.catches_cancel if cancel else kinds.catch_all_exc:
+                        stopped = True
+                        break
+                if stopped:
+                    return targets
+            if ctx.fin is not None:
+                targets.append(ctx.fin.route(self._route_exc(cancel, i)))
+                return targets
+        targets.append(self.cfg.xexit)
+        return targets
+
+    def _route_return(self, depth: Optional[int] = None) -> List[Node]:
+        if depth is None:
+            depth = len(self.stack)
+        for i in range(depth - 1, -1, -1):
+            ctx = self.stack[i]
+            if isinstance(ctx, _Try) and ctx.fin is not None:
+                return [ctx.fin.route(self._route_return(i))]
+        return [self.cfg.exit]
+
+    def _route_jump(self, kind: str, depth: Optional[int] = None
+                    ) -> List[Node]:
+        """break / continue, unwinding through intervening finallys."""
+        if depth is None:
+            depth = len(self.stack)
+        for i in range(depth - 1, -1, -1):
+            ctx = self.stack[i]
+            if isinstance(ctx, _Loop):
+                return [ctx.after if kind == "break" else ctx.head]
+            if isinstance(ctx, _Try) and ctx.fin is not None:
+                return [ctx.fin.route(self._route_jump(kind, i))]
+        return [self.cfg.exit]  # malformed input; fail safe
+
+    # ------------------------------------------------------- building
+
+    def build(self) -> CFG:
+        body = list(self.cfg.fn.body)
+        entry_frontier: _Frontier = []
+        # a synthetic entry lets the analysis start before stmt 0
+        entry = self._node(None, "join")
+        self.cfg.entry = entry
+        frontier = self._seq(body, [(entry, None)])
+        self._connect(frontier, self.cfg.exit)
+        return self.cfg
+
+    def _seq(self, stmts: List[ast.stmt], frontier: _Frontier
+             ) -> _Frontier:
+        for s in stmts:
+            frontier = self._stmt(s, frontier)
+        return frontier
+
+    def _wire_raises(self, node: Node) -> None:
+        if stmt_may_raise(node.stmt):
+            node.esuccs = self._route_exc(False)
+            if stmt_has_await(node.stmt):
+                node.csuccs = self._route_exc(True)
+
+    def _stmt(self, s: ast.stmt, frontier: _Frontier) -> _Frontier:
+        if isinstance(s, ast.If):
+            return self._if(s, frontier)
+        if isinstance(s, (ast.While,)):
+            return self._while(s, frontier)
+        if isinstance(s, (ast.For, getattr(ast, "AsyncFor", ast.For))):
+            return self._for(s, frontier)
+        if isinstance(s, ast.Try):
+            return self._try(s, frontier)
+        if isinstance(s, (ast.With, getattr(ast, "AsyncWith", ast.With))):
+            node = self._node(s, "stmt")
+            self._connect(frontier, node)
+            self._wire_raises(node)
+            return self._seq(s.body, [(node, None)])
+        if getattr(ast, "Match", None) is not None and isinstance(
+                s, ast.Match):
+            node = self._node(s, "stmt")
+            self._connect(frontier, node)
+            self._wire_raises(node)
+            out: _Frontier = [(node, None)]  # no case may match
+            for case in s.cases:
+                out.extend(self._seq(case.body, [(node, None)]))
+            return out
+
+        node = self._node(s, "stmt")
+        self._connect(frontier, node)
+        self._wire_raises(node)
+
+        if isinstance(s, ast.Return):
+            for t in self._route_return():
+                node.succs.append((t, None))
+            return []
+        if isinstance(s, ast.Raise):
+            # a raise's only way forward IS the exception path
+            node.esuccs = self._route_exc(False)
+            return []
+        if isinstance(s, ast.Break):
+            for t in self._route_jump("break"):
+                node.succs.append((t, None))
+            return []
+        if isinstance(s, ast.Continue):
+            for t in self._route_jump("continue"):
+                node.succs.append((t, None))
+            return []
+        return [(node, None)]
+
+    def _if(self, s: ast.If, frontier: _Frontier) -> _Frontier:
+        node = self._node(s, "stmt")
+        self._connect(frontier, node)
+        self._wire_raises(node)
+        dump = ast.dump(s.test)
+        body_f = self._seq(s.body, [(node, (dump, True))])
+        if s.orelse:
+            else_f = self._seq(s.orelse, [(node, (dump, False))])
+        else:
+            else_f = [(node, (dump, False))]
+        return body_f + else_f
+
+    @staticmethod
+    def _literal_true(test: ast.expr) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value) is True
+
+    def _while(self, s: ast.While, frontier: _Frontier) -> _Frontier:
+        head = self._node(s, "stmt")
+        after = self._node(None, "join")
+        self._connect(frontier, head)
+        self._wire_raises(head)
+        self.stack.append(_Loop(head, after))
+        body_f = self._seq(s.body, [(head, None)])
+        self.stack.pop()
+        self._connect(body_f, head)  # back edge
+        if not self._literal_true(s.test):
+            if s.orelse:
+                else_f = self._seq(s.orelse, [(head, None)])
+                self._connect(else_f, after)
+            else:
+                head.succs.append((after, None))
+        return [(after, None)] if any(
+            t is after for n in self.cfg.nodes for t, _ in n.succs
+        ) else []
+
+    def _for(self, s, frontier: _Frontier) -> _Frontier:
+        head = self._node(s, "stmt")
+        after = self._node(None, "join")
+        self._connect(frontier, head)
+        self._wire_raises(head)
+        self.stack.append(_Loop(head, after))
+        body_f = self._seq(s.body, [(head, None)])
+        self.stack.pop()
+        self._connect(body_f, head)  # back edge
+        if s.orelse:
+            else_f = self._seq(s.orelse, [(head, None)])
+            self._connect(else_f, after)
+        else:
+            head.succs.append((after, None))  # iterable may be empty
+        return [(after, None)]
+
+    def _try(self, s: ast.Try, frontier: _Frontier) -> _Frontier:
+        fin = _Fin(self) if s.finalbody else None
+        handlers = [(_HandlerKinds(h.type),
+                     self._node(h, "handler"))
+                    for h in s.handlers]
+        for _kinds, hnode in handlers:
+            hnode.cleanup = True
+        ctx = _Try(handlers, fin)
+
+        self.stack.append(ctx)
+        body_f = self._seq(s.body, frontier)
+        ctx.state = "else"  # handlers do not protect else
+        if s.orelse:
+            body_f = self._seq(s.orelse, body_f)
+        ctx.state = "handler"  # nor their own bodies
+        handler_fs: _Frontier = []
+        self.cleanup_depth += 1
+        for h, (_kinds, hnode) in zip(s.handlers, handlers):
+            handler_fs.extend(self._seq(h.body, [(hnode, None)]))
+        self.cleanup_depth -= 1
+        self.stack.pop()
+
+        normal_f = body_f + handler_fs
+        if fin is None:
+            return normal_f
+        # one finalbody instance per unwinding route (registered during
+        # the protected region's build), each resuming ONLY its own
+        # continuation — unless the instance never completes normally
+        # (return-inside-finally), which swallows it, as Python does
+        self.stack.append(_TryFinallyShield())
+        self.cleanup_depth += 1
+        for entry, targets in list(fin.routes.values()):
+            inst_f = self._seq(s.finalbody, [(entry, None)])
+            for t in targets:
+                self._connect(inst_f, t)
+        out: _Frontier = []
+        if normal_f:
+            # the fall-through instance: its continuation is whatever
+            # statement follows the try, i.e. this call's return value
+            entry = self._node(None, "finally")
+            self._connect(normal_f, entry)
+            out = self._seq(s.finalbody, [(entry, None)])
+        self.cleanup_depth -= 1
+        self.stack.pop()
+        return out
+
+
+class _TryFinallyShield:
+    """Placeholder context while a finalbody is being built: routing
+    from inside the finally must not re-enter the finally's own try
+    (it is no longer protecting), and the surrounding contexts were
+    popped with it.  An empty marker keeps stack depths honest."""
+    __slots__ = ()
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG for one function/method AST node."""
+    return _Builder(fn).build()
+
+
+def cfg_for(index, fi) -> CFG:
+    """Memoized CFG for a pass-1 ``FunctionInfo`` (cache rides on the
+    ProjectIndex, so one bench/CLI run builds each graph at most once)."""
+    cache = getattr(index, "_cfg_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(index, "_cfg_cache", cache)
+    c = cache.get(fi.qname)
+    if c is None:
+        c = build_cfg(fi.node)
+        cache[fi.qname] = c
+    return c

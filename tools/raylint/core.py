@@ -54,6 +54,9 @@ RULES = {
     "R10": "method-contract",
     "R11": "mutation-durability",
     "R12": "knob-drift",
+    "R13": "lifecycle-pairing",
+    "R14": "cancellation-unsafety",
+    "R15": "orphaned-task",
     "S1": "unused-suppression",
 }
 #: the r17 contract rules need the cross-file wire registry built
@@ -426,7 +429,7 @@ def format_sarif(report: dict) -> str:
             "tool": {
                 "driver": {
                     "name": "raylint",
-                    "version": "3.0",
+                    "version": "4.0",
                     "informationUri": (
                         "DESIGN.md#enforced-invariants-raylint"
                     ),

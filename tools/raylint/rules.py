@@ -1,4 +1,4 @@
-"""raylint rule checkers R1–R12.
+"""raylint rule checkers R1–R15.
 
 Every rule is grounded in an invariant this codebase already relies on
 (see DESIGN.md "Enforced invariants" for the PR that introduced each):
@@ -70,6 +70,33 @@ R12 knob-drift             (r17, contract pass) every ``_d()``-defined
                            knob in config.py is read somewhere via
                            ``GLOBAL_CONFIG``, every read is defined,
                            and every knob is documented in DESIGN.md.
+R13 lifecycle-pairing      (PR 20, CFG pass) every path from a
+                           registered resource acquire (store creator
+                           pin, deposit sink, pooled peer conn, actor
+                           submit-window credit, journal flush future,
+                           provisioned slice/QR — see
+                           ``_RESOURCE_REGISTRY``) to function exit
+                           reaches exactly one release: a raise/return
+                           path with zero is a leak, a path with two is
+                           a double-release.  Release-in-``finally``/
+                           ``else`` or ownership transfer through a
+                           registered escape (return it, store it on an
+                           object, hand it to ``_transfers``/a sink/
+                           the intent journal) satisfies the pairing.
+R14 cancellation-unsafety  (PR 20, CFG pass) an ``await`` between an
+                           acquire and its protecting release in an
+                           ``async def``: ``CancelledError`` is a
+                           BaseException, so the PR 2 ``_pull_striped``
+                           and PR 7 reaper-credit incidents leaked
+                           straight past ``except Exception`` — the
+                           cancellation edge must reach a release.
+R15 orphaned-task          (PR 20) a bare ``asyncio.create_task`` /
+                           ``ensure_future`` statement drops the only
+                           strong reference to the task: the event
+                           loop holds weak refs, so GC can collect it
+                           mid-flight, and its exception is silently
+                           swallowed — keep a reference and reap it
+                           (``rpc.spawn``), store it, or await it.
 
 Scoping: R1 applies to files under a ``_private/`` directory; R3 and the
 module prong of R4 apply to the wire/control modules by basename (R4
@@ -94,6 +121,12 @@ partial run sees a partial wire surface and may over-report dead
 handlers/knobs.  Their findings skip files under ``tests/`` /
 ``examples/`` (fixture servers use throwaway method strings by
 design), though handlers and callers are collected from everywhere.
+The PR 20 lifecycle rules R13–R15 apply to the plane packages — files
+under ``_private/``, ``serve/`` or ``mesh/`` — the home of every
+registered paired-lifecycle resource; their CFGs (pass 4,
+:mod:`tools.raylint.cfg`) are built lazily, only for functions whose
+pass-1 call list contains a registered acquire name, and memoized on
+the index.
 """
 
 from __future__ import annotations
@@ -561,6 +594,618 @@ def _check_r9(tree: ast.AST, path: str, func_of,
                     func_line=fn.lineno if fn else None))
 
 
+# ------------------------------------- lifecycle flow rules (PR 20)
+
+from tools.raylint.cfg import cfg_for, expr_walk, header_exprs
+
+
+class _Kind:
+    """One registered paired-lifecycle resource (see DESIGN.md
+    "Resource registry").  Matching is by the LAST dotted component of
+    a call target (exact equality, never ``endswith`` — so
+    ``cd_sink_register`` does not impersonate ``sink_register``), with
+    an optional receiver-substring gate for generic names like
+    ``.acquire``/``.release``; underscore-prefixed names are
+    project-unique and skip the receiver gate."""
+
+    __slots__ = ("key", "what", "acquire", "release", "escape_calls",
+                 "acq_recv", "rel_recv", "bound_only", "leak_on_exc",
+                 "track_binding", "key_policy", "fix_hint")
+
+    def __init__(self, key, what, acquire, release, escape_calls=(),
+                 acq_recv=None, rel_recv=None, bound_only=False,
+                 leak_on_exc=True, track_binding=False,
+                 key_policy="first_arg", fix_hint=""):
+        self.key = key
+        self.what = what
+        self.acquire = frozenset(acquire)
+        self.release = frozenset(release)
+        self.escape_calls = frozenset(escape_calls)
+        self.acq_recv = acq_recv
+        self.rel_recv = rel_recv
+        #: only track acquires whose result is bound to a local name
+        #: (a discarded result is an intentional ownership transfer —
+        #: the serve provision hook fires QRs the cluster owns)
+        self.bound_only = bound_only
+        #: False: an exception/cancellation path without a release is
+        #: fine (journal futures resolve via the group-commit timer
+        #: whether or not anyone waits) — only a NORMAL return without
+        #: one is a leak (complements R11 for non-handler code)
+        self.leak_on_exc = leak_on_exc
+        #: True: rebinding/deleting the bound name while the resource
+        #: is live is itself a leak (a dropped QR handle cannot be
+        #: deleted later)
+        self.track_binding = track_binding
+        self.key_policy = key_policy  # first_arg | binding | none
+        self.fix_hint = fix_hint
+
+
+_RESOURCE_REGISTRY = [
+    _Kind("store-pin",
+          "store creator pin",
+          acquire={"create_buffer", "_create_with_spill",
+                   "_create_local_with_spill"},
+          release={"seal", "abort"},
+          fix_hint="seal/abort on every path (abort in an `except "
+                   "BaseException` arm so cancellation cleans up too)"),
+    _Kind("deposit-sink",
+          "conduit deposit sink",
+          acquire={"sink_register"},
+          release={"sink_unregister"},
+          fix_hint="sink_unregister in the finally/BaseException arm"),
+    _Kind("pool-conn",
+          "pooled peer connection",
+          acquire={"acquire"}, release={"release"},
+          acq_recv="pool", rel_recv="pool",
+          fix_hint="pool.release(addr, conn) in a finally (discard=True "
+                   "on error paths)"),
+    _Kind("actor-window",
+          "actor submit-window credit",
+          acquire={"acquire"},
+          release={"release", "_release_window"},
+          acq_recv="win", rel_recv="win",
+          escape_calls={"_push_actor_stream"},
+          key_policy="none",
+          fix_hint="win.release() in a finally, or hand the credit to "
+                   "the stream (_push_actor_stream owns it after)"),
+    _Kind("journal-fut",
+          "GCS journal flush future",
+          acquire={"_journal", "_journal_actor", "_journal_pg"},
+          release={"_journal_wait"},
+          bound_only=True, leak_on_exc=False, key_policy="binding",
+          fix_hint="await self._journal_wait(fut) before replying "
+                   "(durable-at-ack, r7/r16)"),
+    _Kind("qr-slice",
+          "provisioned slice / queued resource",
+          acquire={"create_slice", "create_queued_resource"},
+          release={"delete_slice", "delete_queued_resource"},
+          escape_calls={"_put_intent"},
+          bound_only=True, track_binding=True, key_policy="binding",
+          fix_hint="journal the intent (_put_intent names the slice; "
+                   "recovery adopts it) or delete_slice on the error "
+                   "path"),
+]
+
+#: R15: loop-spawn entry points whose result must not be dropped
+_R15_SPAWNS = frozenset({"create_task", "ensure_future"})
+
+#: every registered release/escape name: a statement making one of
+#: these calls is commit/cleanup code by construction, so its own
+#: may-raise-ness is not reported as a fresh leak path for OTHER
+#: resources still live at it (same optimism as release-on-esucc)
+_ALL_RELEASE_NAMES = frozenset(
+    n for k in _RESOURCE_REGISTRY for n in (k.release | k.escape_calls)
+)
+
+
+def _last_recv(call: ast.Call):
+    name = _dotted(call.func)
+    if "." in name:
+        recv, _, last = name.rpartition(".")
+        return last, recv.lower()
+    return name, ""
+
+
+def _postorder_calls(exprs) -> List[ast.Call]:
+    """Call nodes of ``exprs`` in (approximate) evaluation order —
+    children before parents, so ``outer(inner())`` yields inner first.
+    Lambda bodies are deferred code and are skipped."""
+    out: List[ast.Call] = []
+
+    def rec(n):
+        if isinstance(n, ast.Lambda):
+            return
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+        if isinstance(n, ast.Call):
+            out.append(n)
+
+    for e in exprs:
+        if e is not None:
+            rec(e)
+    return out
+
+
+def _none_guard_dumps(var: str) -> Dict[str, bool]:
+    """Edge guards under which the nullable-acquire result ``var`` is
+    known absent (``_create_local_with_spill`` returns None when the
+    object already exists locally): guard dump -> the polarity meaning
+    'not acquired on this branch'."""
+    out: Dict[str, bool] = {}
+    for src, pol in ((f"{var} is None", True),
+                     (f"{var} is not None", False),
+                     (f"not {var}", True),
+                     (var, False)):
+        try:
+            out[ast.dump(ast.parse(src, mode="eval").body)] = pol
+        except SyntaxError:  # pragma: no cover - var is an identifier
+            pass
+    return out
+
+
+def _guard_context(fn: ast.AST, target: ast.stmt) -> Dict[str, bool]:
+    """(test-dump -> polarity) of every ``if`` enclosing ``target`` —
+    later branches on a syntactically identical test follow only the
+    same polarity (the ``if native_sink:`` acquire/release correlation
+    in ``_pull_striped``).  Best-effort: a reassigned condition variable
+    defeats it, which over-approximates paths (never hides one)."""
+    found: Dict[str, bool] = {}
+
+    def rec(stmts, ctx) -> bool:
+        for st in stmts:
+            if st is target:
+                found.update(ctx)
+                return True
+            if isinstance(st, ast.If):
+                d = ast.dump(st.test)
+                if rec(st.body, {**ctx, d: True}):
+                    return True
+                if rec(st.orelse, {**ctx, d: False}):
+                    return True
+            elif isinstance(st, (ast.While, ast.For,
+                                 getattr(ast, "AsyncFor", ast.For))):
+                if rec(st.body, ctx) or rec(st.orelse, ctx):
+                    return True
+            elif isinstance(st, ast.Try):
+                if (rec(st.body, ctx) or rec(st.orelse, ctx)
+                        or rec(st.finalbody, ctx)):
+                    return True
+                for h in st.handlers:
+                    if rec(h.body, ctx):
+                        return True
+            elif isinstance(st, (ast.With,
+                                 getattr(ast, "AsyncWith", ast.With))):
+                if rec(st.body, ctx):
+                    return True
+        return False
+
+    rec(fn.body, {})
+    return found
+
+
+class _Site:
+    """One qualified acquire site under flow analysis."""
+
+    __slots__ = ("node", "call", "var", "key_arg", "tail", "lineno",
+                 "col")
+
+    def __init__(self, node, call, var, key_arg, tail):
+        self.node = node          # cfg Node holding the acquire
+        self.call = call          # the acquire ast.Call
+        self.var = var            # bound local name, if any
+        self.key_arg = key_arg    # first positional arg name, if a Name
+        self.tail = tail          # calls evaluated after it, same stmt
+        self.lineno = call.lineno
+        self.col = call.col_offset
+
+
+def _release_match(kind: _Kind, site: _Site, call: ast.Call,
+                   last: str, recv: str) -> bool:
+    if last not in kind.release:
+        return False
+    if (kind.rel_recv and not last.startswith("_")
+            and kind.rel_recv not in recv):
+        return False
+    if kind.key_policy == "first_arg":
+        # seal(oid) pairs with create_buffer(oid, ...): require equal
+        # first-arg names when both are plain names, else permissive
+        a0 = call.args[0] if call.args else None
+        if (site.key_arg and isinstance(a0, ast.Name)
+                and a0.id != site.key_arg):
+            return False
+        return True
+    if kind.key_policy == "binding":
+        if site.var is None or not call.args:
+            return True
+        names = [a.id for a in call.args if isinstance(a, ast.Name)]
+        return site.var in names or not names
+    return True  # "none": releases are unkeyed (window credits)
+
+
+def _site_events(kind: _Kind, site: _Site, node, calls) -> List:
+    """Ordered lifecycle events evaluating ``node`` applies to the
+    site's resource."""
+    ev: List = []
+    for c in calls:
+        last, recv = _last_recv(c)
+        if c is site.call:
+            ev.append(("acquire", c))
+        elif _release_match(kind, site, c, last, recv):
+            ev.append(("release", c))
+        elif last in kind.escape_calls:
+            ev.append(("escape", c))
+    stmt = node.stmt
+    var = site.var
+    if var and node.kind == "stmt":
+        if isinstance(stmt, ast.Assign):
+            refs_var = any(isinstance(x, ast.Name) and x.id == var
+                           for x in expr_walk([stmt.value]))
+            for t in stmt.targets:
+                if (isinstance(t, (ast.Attribute, ast.Subscript))
+                        and refs_var):
+                    ev.append(("escape", stmt))
+                elif (isinstance(t, ast.Name) and t.id == var
+                      and stmt is not site.node.stmt
+                      and kind.key_policy == "binding"):
+                    # rebinding only matters when the binding IS the
+                    # handle; a first_arg-keyed pin (seal(oid)) outlives
+                    # `del buf` / buffer rebinds
+                    ev.append(("kill", stmt))
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            if any(isinstance(x, ast.Name) and x.id == var
+                   for x in expr_walk([stmt.value])):
+                ev.append(("escape", stmt))
+        elif isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, (ast.Yield, ast.YieldFrom)):
+            if any(isinstance(x, ast.Name) and x.id == var
+                   for x in expr_walk([stmt.value])):
+                ev.append(("escape", stmt))
+        elif isinstance(stmt, ast.Delete) and kind.key_policy == "binding":
+            if any(isinstance(t, ast.Name) and t.id == var
+                   for t in stmt.targets):
+                ev.append(("kill", stmt))
+    return ev
+
+
+def _find_sites(fi, graph, kind: _Kind, node_calls, path: str,
+                findings: List[Finding]) -> List[_Site]:
+    sites: List[_Site] = []
+    for n in graph.nodes:
+        if n.kind != "stmt":
+            continue
+        calls = node_calls.get(n.idx) or ()
+        for i, call in enumerate(calls):
+            last, recv = _last_recv(call)
+            if last not in kind.acquire:
+                continue
+            if (kind.acq_recv and not last.startswith("_")
+                    and kind.acq_recv not in recv):
+                continue
+            stmt = n.stmt
+            # classify the call's position inside its statement
+            parents: Dict[int, ast.AST] = {}
+            for a in ast.walk(stmt):
+                for c in ast.iter_child_nodes(a):
+                    parents[id(c)] = a
+            in_comp = in_cond = False
+            p = parents.get(id(call))
+            while p is not None and p is not stmt:
+                if isinstance(p, (ast.ListComp, ast.SetComp,
+                                  ast.DictComp, ast.GeneratorExp)):
+                    in_comp = True
+                if isinstance(p, (ast.BoolOp, ast.IfExp, ast.Lambda)):
+                    in_cond = True
+                p = parents.get(id(p))
+            if in_comp:
+                findings.append(Finding(
+                    path, call.lineno, call.col_offset, "R13",
+                    f"{kind.what} acquired inside a comprehension "
+                    f"cannot be lifecycle-paired on any path — bind "
+                    f"it in a statement so the release is trackable",
+                    func_line=fi.lineno))
+                continue
+            if in_cond:
+                continue  # short-circuit operand: conditional probe
+            if isinstance(stmt, (ast.If, ast.While)):
+                continue  # acquire in a branch test (try_acquire probe)
+            if isinstance(stmt, ast.Return):
+                continue  # ownership passes to the caller at birth
+            if isinstance(stmt, (ast.With,
+                                 getattr(ast, "AsyncWith", ast.With))):
+                hdr = [it.context_expr for it in stmt.items]
+                hdr += [h.value for h in hdr if isinstance(h, ast.Await)]
+                if call in hdr:
+                    continue  # the context manager owns the release
+            var = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                v = stmt.value
+                inner = v.value if isinstance(v, ast.Await) else v
+                if inner is call:
+                    t = stmt.targets[0]
+                    if isinstance(t, ast.Name):
+                        var = t.id
+                    elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                        continue  # stored on an object at birth
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                v = stmt.value
+                inner = v.value if isinstance(v, ast.Await) else v
+                if inner is call and isinstance(stmt.target, ast.Name):
+                    var = stmt.target.id
+            if kind.bound_only and var is None:
+                continue
+            key_arg = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                key_arg = call.args[0].id
+            sites.append(_Site(n, call, var, key_arg, calls[i + 1:]))
+    return sites
+
+
+def _analyze_site(fi, graph, kind: _Kind, site: _Site, node_calls,
+                  path: str, findings: List[Finding]) -> None:
+    guards = _guard_context(fi.node, site.node.stmt)
+    ng = _none_guard_dumps(site.var) if site.var else {}
+    ev_cache: Dict[int, List] = {}
+
+    def events(n):
+        e = ev_cache.get(n.idx)
+        if e is None:
+            e = _site_events(kind, site, n, node_calls.get(n.idx) or ())
+            ev_cache[n.idx] = e
+        return e
+
+    emitted: Set = set()
+
+    def emit(tag, at):
+        key = (tag, at.lineno)
+        if key in emitted:
+            return
+        emitted.add(key)
+        if tag == "double":
+            findings.append(Finding(
+                path, at.lineno, at.col_offset, "R13",
+                f"double release of the {kind.what} acquired at line "
+                f"{site.lineno} ({fi.name}): a path reaches this "
+                f"release with the resource already released — gate "
+                f"it, or release on exactly one path",
+                func_line=fi.lineno))
+        elif tag == "kill" and kind.track_binding:
+            findings.append(Finding(
+                path, at.lineno, at.col_offset, "R13",
+                f"the {kind.what} handle acquired at line "
+                f"{site.lineno} ({fi.name}) is overwritten while "
+                f"still live: nothing can release it afterwards — "
+                f"{kind.fix_hint}",
+                func_line=fi.lineno))
+        elif tag == "reacquire" and kind.track_binding:
+            findings.append(Finding(
+                path, at.lineno, at.col_offset, "R13",
+                f"the {kind.what} acquired at line {site.lineno} "
+                f"({fi.name}) is still live when the loop re-acquires "
+                f"— release it before the back edge",
+                func_line=fi.lineno))
+
+    def apply(evs, state):
+        count, esc = state
+        for tag, at in evs:
+            if esc:
+                break
+            if tag == "release":
+                if count >= 1:
+                    emit("double", at)
+                    count = 2
+                else:
+                    count = 1
+            elif tag == "escape":
+                esc = True
+            elif tag in ("kill", "acquire"):
+                if count == 0:
+                    emit(tag if tag == "kill" else "reacquire", at)
+                esc = True
+            # saturate; findings are per-line deduped
+        return (count, esc)
+
+    def live(state):
+        return state[0] == 0 and not state[1]
+
+    def follow(state, guard):
+        """Propagate ``state`` across an edge with ``guard``; None =
+        path-inconsistent with the acquire's own branch context."""
+        if guard is None:
+            return state
+        d, pol = guard
+        want = guards.get(d)
+        if want is not None and want != pol:
+            return None
+        if ng.get(d) == pol:
+            return (state[0], True)  # null-guard: was never acquired
+        return state
+
+    def edge_ok(guard):
+        if guard is None:
+            return True
+        d, pol = guard
+        want = guards.get(d)
+        if want is not None and want != pol:
+            return False
+        return ng.get(d) != pol
+
+    def releaseish(n) -> bool:
+        """Is this statement commit/cleanup code for SOME registered
+        resource (its calls include a release/escape name)?"""
+        return any(_last_recv(c)[0] in _ALL_RELEASE_NAMES
+                   for c in (node_calls.get(n.idx) or ()))
+
+    reach_memo: Dict[int, bool] = {}
+
+    def release_reachable(n) -> bool:
+        """Does some normal-edge path from ``n`` reach a release/escape
+        for this site?  Used to treat cleanup code optimistically: a
+        may-raise point inside an except/finally body whose straight
+        line ends in the release is not reported as its own leak path
+        (otherwise every line of a multi-line cleanup handler would
+        need a nested try of its own)."""
+        got = reach_memo.get(n.idx)
+        if got is not None:
+            return got
+        reach_memo[n.idx] = False  # cycle guard
+        res = any(t in ("release", "escape") for t, _ in events(n)) \
+            or any(release_reachable(v) for v, g in n.succs
+                   if edge_ok(g) and v.kind not in ("exit", "xexit"))
+        reach_memo[n.idx] = res
+        return res
+
+    leaky_memo: Dict[int, bool] = {}
+
+    def leaky(n, stack=None) -> bool:
+        """Can a path from ``n`` reach exit without a release/escape?
+        (The cancellation-target check for R14.)"""
+        got = leaky_memo.get(n.idx)
+        if got is not None:
+            return got
+        if n.kind in ("exit", "xexit"):
+            return True
+        if stack is None:
+            stack = set()
+        if n.idx in stack:
+            return False  # cycles alone do not reach exit
+        if any(t in ("release", "escape") for t, _ in events(n)):
+            leaky_memo[n.idx] = False
+            return False
+        if n.cleanup and release_reachable(n):
+            # inside cleanup code that straight-lines to the release:
+            # its own may-raise points are not counted as leak paths
+            leaky_memo[n.idx] = False
+            return False
+        stack.add(n.idx)
+        res = any(leaky(v, stack) for v, g in n.succs if edge_ok(g)) \
+            or any(leaky(v, stack) for v in n.esuccs) \
+            or any(leaky(v, stack) for v in n.csuccs)
+        stack.discard(n.idx)
+        leaky_memo[n.idx] = res
+        return res
+
+    leaks: List = []    # (lineno, col, how)
+    r14_at: Set = set()
+    seen: Dict[int, Set] = {}
+    work: List = []
+
+    def push(n, st):
+        s = seen.setdefault(n.idx, set())
+        if st not in s:
+            s.add(st)
+            work.append((n, st))
+
+    # seed: state just after the acquire call, remaining same-statement
+    # events applied (nested `release(acquire(...))` shapes pair here).
+    # The acquire statement's own exception/cancellation edges are NOT
+    # explored: whether the acquire happened before the failure is
+    # unknowable, and flagging it would make every acquire a finding.
+    st0 = apply(_site_events(kind, site, site.node, site.tail), (0, False))
+    for v, g in site.node.succs:
+        stf = follow(st0, g)
+        if stf is None:
+            continue
+        if v.kind == "exit":
+            if live(stf):
+                leaks.append((site.node, "fall-through"))
+        else:
+            push(v, stf)
+
+    while work:
+        n, st = work.pop()
+        out = apply(events(n), st)
+        for v, g in n.succs:
+            stf = follow(out, g)
+            if stf is None:
+                continue
+            if v.kind == "exit":
+                if live(stf):
+                    leaks.append((n, "return"))
+            elif v.kind == "xexit":
+                if live(stf) and kind.leak_on_exc and not n.csuccs:
+                    leaks.append((n, "raise"))
+            else:
+                push(v, stf)
+        for v in n.esuccs:
+            if v.kind == "xexit":
+                if (live(out) and kind.leak_on_exc and not n.csuccs
+                        and not (n.cleanup and release_reachable(n))
+                        and not (releaseish(n)
+                                 and release_reachable(n))):
+                    leaks.append((n, "uncaught-exception"))
+            elif v.kind != "exit":
+                push(v, out)
+        if n.csuccs and live(out) and kind.leak_on_exc and fi.is_async:
+            if n.idx not in r14_at and any(leaky(v) for v in n.csuccs):
+                r14_at.add(n.idx)
+                findings.append(Finding(
+                    path, n.lineno, getattr(n.stmt, "col_offset", 0),
+                    "R14",
+                    f"await between the {kind.what} acquire (line "
+                    f"{site.lineno}) and its release in async def "
+                    f"{fi.name}: CancelledError here skips every "
+                    f"`except Exception` and leaks it — "
+                    f"{kind.fix_hint}",
+                    func_line=fi.lineno))
+
+    if leaks:
+        n, how = min(leaks, key=lambda x: (x[0].lineno, x[1]))
+        rel = "/".join(sorted(kind.release))
+        findings.append(Finding(
+            path, n.lineno or site.lineno,
+            getattr(n.stmt, "col_offset", 0), "R13",
+            f"{kind.what} acquired at line {site.lineno} leaks: a "
+            f"{how} path reaches function exit ({fi.name}) without "
+            f"{rel} — {kind.fix_hint}",
+            func_line=fi.lineno))
+
+
+def _check_lifecycle(fi, index: ProjectIndex, path: str,
+                     enabled: Set[str],
+                     findings: List[Finding]) -> None:
+    """R13/R14 driver for one function: pre-filter on the pass-1 call
+    list, then build (memoized) the CFG and run each qualified acquire
+    site through the flow analysis."""
+    last_names = {c.name.rsplit(".", 1)[-1] for c in fi.calls}
+    kinds = [k for k in _RESOURCE_REGISTRY if last_names & k.acquire]
+    if not kinds:
+        return
+    graph = cfg_for(index, fi)
+    node_calls = {
+        n.idx: _postorder_calls(header_exprs(n.stmt))
+        for n in graph.nodes if n.kind == "stmt"
+    }
+    raw: List[Finding] = []
+    for kind in kinds:
+        for site in _find_sites(fi, graph, kind, node_calls, path, raw):
+            _analyze_site(fi, graph, kind, site, node_calls, path, raw)
+    # an acquire inside a finalbody exists once per finally instance —
+    # identical findings collapse
+    seen_f: Set[Tuple] = set()
+    for f in raw:
+        key = (f.line, f.col, f.rule, f.message)
+        if f.rule in enabled and key not in seen_f:
+            seen_f.add(key)
+            findings.append(f)
+
+
+def _check_r15(fi, path: str, findings: List[Finding]) -> None:
+    for n in walk_body(fi.node):
+        if not (isinstance(n, ast.Expr) and isinstance(n.value, ast.Call)):
+            continue
+        name = _dotted(n.value.func)
+        last = name.rsplit(".", 1)[-1]
+        if last in _R15_SPAWNS:
+            findings.append(Finding(
+                path, n.lineno, n.col_offset, "R15",
+                f"fire-and-forget {last}() in {fi.name}: the task "
+                f"object is dropped — the loop keeps only a weak ref "
+                f"(GC can collect it mid-flight) and its exception is "
+                f"swallowed; use rpc.spawn() (tracked + reaped), "
+                f"store the task, or await it",
+                func_line=fi.lineno))
+
+
 # ---------------------------------------------------------------- driver
 
 
@@ -643,6 +1288,16 @@ def check_tree(tree: ast.AST, path: str, enabled: Set[str],
                 _check_r7(fi, index, path, findings)
             if "R8" in enabled:
                 _check_r8(fi, index, path, findings)
+    # r20 lifecycle rules: plane packages only (tests/tools excluded —
+    # fixtures there exercise the bad shapes on purpose)
+    in_lc_scope = in_private or bool({"serve", "mesh"}
+                                     & set(posix.split("/")))
+    if fis is not None and in_lc_scope:
+        for fi in fis:
+            if {"R13", "R14"} & enabled:
+                _check_lifecycle(fi, index, path, enabled, findings)
+            if "R15" in enabled:
+                _check_r15(fi, path, findings)
     for node in fn_nodes:
         if isinstance(node, ast.AsyncFunctionDef):
             if "R1" in enabled and in_private:
